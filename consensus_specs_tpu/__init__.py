@@ -1,0 +1,21 @@
+"""consensus_specs_tpu — a TPU-native executable Ethereum PoS consensus spec.
+
+A ground-up rebuild of the capabilities of the eth2 `consensus-specs` pyspec
+(reference: /root/reference, v1.1.3): executable phase0/altair/merge specs with
+mainnet+minimal presets, an SSZ engine, a multi-backend BLS switchboard whose
+fast path is JAX/Pallas BLS12-381 kernels on TPU, a test harness, and
+cross-client test-vector generators.
+
+Layout (mirrors SURVEY.md layer map):
+  utils/      L0: SSZ typing+merkleization, hashing, BLS switchboard, merkle helpers
+  config/     L1: preset/config YAML loaders
+  specsrc/    L2: fork spec sources (authored Python, layered like the reference's
+              markdown: later forks override earlier definitions)
+  builder.py  L3: spec builder — binds (fork, preset, config) -> importable module
+  ops/        TPU compute plane: limb field arithmetic, curve ops, pairing kernels
+  parallel/   device-mesh sharding of the committee/epoch axes
+  gen/        L6: test-vector generator runtime
+  debug/      SSZ<->JSON codecs + random object generation
+"""
+
+__version__ = "0.1.0"

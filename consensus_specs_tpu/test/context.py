@@ -1,0 +1,6 @@
+"""Test-harness context globals (full decorator algebra added with the spec layer).
+
+(reference: tests/core/pyspec/eth2spec/test/context.py)
+"""
+DEFAULT_TEST_PRESET = "minimal"
+DEFAULT_PYTEST_FORKS = None

@@ -1,0 +1,229 @@
+"""BLS switchboard: multi-backend IETF BLS signature API.
+
+Mirrors the reference's backend-switch design
+(reference: tests/core/pyspec/eth2spec/utils/bls.py:6-111), with backends:
+
+- "py_ecc":  the pure-Python oracle in `consensus_specs_tpu.utils.bls12_381`
+             (named after the reference's default backend for API parity; the
+             reference's py_ecc==5.2.0 is replaced by our implementation).
+- "milagro": alias of the oracle (the reference's milagro C binding has no
+             place here; kept so `use_milagro()` call sites keep working).
+- "tpu":     the JAX/Pallas batched backend in `consensus_specs_tpu.ops`
+             (the reference's native-C-equivalent, lowered to TPU kernels).
+
+Ciphersuite: BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_ (IETF BLS draft v4,
+reference specs/phase0/beacon-chain.md:631-652).
+"""
+from typing import Sequence
+
+from . import bls12_381 as oracle
+from .bls12_381 import (
+    G1_GEN, R, ec_add, ec_eq, ec_from_affine, ec_mul, ec_neg, ec_to_affine,
+    g1_from_bytes, g1_to_bytes, g2_from_bytes, g2_to_bytes, hash_to_g2,
+    is_in_g1_subgroup, is_in_g2_subgroup, multi_pairing, Fq12,
+)
+
+bls_active = True
+_backend = "py_ecc"
+
+DST = b"BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_"
+
+STUB_SIGNATURE = b"\x11" * 96
+STUB_PUBKEY = b"\x22" * 48
+G2_POINT_AT_INFINITY = b"\xc0" + b"\x00" * 95
+STUB_COORDINATES = ((oracle.G2_X0, oracle.G2_X1), (oracle.G2_Y0, oracle.G2_Y1))
+
+
+def use_py_ecc():
+    global _backend
+    _backend = "py_ecc"
+
+
+def use_milagro():
+    # API-parity alias: this build has no milagro C binding; the oracle serves.
+    global _backend
+    _backend = "py_ecc"
+
+
+def use_tpu():
+    global _backend
+    _backend = "tpu"
+
+
+def backend_name() -> str:
+    return _backend
+
+
+def only_with_bls(alt_return=None):
+    """Decorator: skip the BLS op (returning alt_return) when bls_active is off
+    (reference: utils/bls.py:33-44)."""
+
+    def decorator(fn):
+        def wrapper(*args, **kwargs):
+            if not bls_active:
+                return alt_return
+            return fn(*args, **kwargs)
+
+        wrapper.__name__ = fn.__name__
+        return wrapper
+
+    return decorator
+
+
+# ---------------------------------------------------------------------------
+# point helpers (also used by the spec's custody-game crypto)
+# ---------------------------------------------------------------------------
+
+
+def pubkey_to_G1(pubkey: bytes):
+    return g1_from_bytes(bytes(pubkey))
+
+
+def signature_to_G2(signature: bytes):
+    """Decompress a signature into G2 affine coordinate integers
+    (((x_c0, x_c1), (y_c0, y_c1))); reference: utils/bls.py:90-92."""
+    aff = g2_from_bytes(bytes(signature))
+    if aff is None:
+        return None
+    x, y = aff
+    return ((x.c0, x.c1), (y.c0, y.c1))
+
+
+def _key_validate_point(pubkey: bytes):
+    """KeyValidate: valid encoding, not infinity, in the G1 subgroup.
+    Returns the affine point; raises on failure."""
+    aff = g1_from_bytes(bytes(pubkey))
+    if aff is None:
+        raise ValueError("pubkey is the point at infinity")
+    if not is_in_g1_subgroup(ec_from_affine(aff)):
+        raise ValueError("pubkey not in G1 subgroup")
+    return aff
+
+
+def KeyValidate(pubkey: bytes) -> bool:
+    try:
+        _key_validate_point(pubkey)
+        return True
+    except ValueError:
+        return False
+
+
+def _sig_to_checked_point(signature: bytes):
+    aff = g2_from_bytes(bytes(signature))
+    if aff is None:
+        raise ValueError("signature is the point at infinity")
+    if not is_in_g2_subgroup(ec_from_affine(aff)):
+        raise ValueError("signature not in G2 subgroup")
+    return aff
+
+
+def _core_verify(pk_aff, message: bytes, sig_aff) -> bool:
+    """e(PK, H(m)) == e(g1, sig), as prod e(PK, H(m)) * e(-g1, sig) == 1."""
+    h = ec_to_affine(hash_to_g2(bytes(message), DST))
+    neg_gen = ec_to_affine(ec_neg(G1_GEN))
+    res = multi_pairing([(pk_aff, h), (neg_gen, sig_aff)])
+    return res == Fq12.one()
+
+
+# ---------------------------------------------------------------------------
+# IETF BLS API (draft v4); exception-swallowing verify wrappers match the
+# reference (utils/bls.py:47-74)
+# ---------------------------------------------------------------------------
+
+
+@only_with_bls(alt_return=True)
+def Verify(PK: bytes, message: bytes, signature: bytes) -> bool:
+    try:
+        if _backend == "tpu":
+            from ..ops import bls_backend as tpu_backend
+
+            return tpu_backend.verify(PK, message, signature)
+        pk_aff = _key_validate_point(PK)
+        sig_aff = _sig_to_checked_point(signature)
+        return _core_verify(pk_aff, bytes(message), sig_aff)
+    except Exception:
+        return False
+
+
+@only_with_bls(alt_return=True)
+def AggregateVerify(pubkeys: Sequence[bytes], messages: Sequence[bytes], signature: bytes) -> bool:
+    try:
+        if len(pubkeys) == 0 or len(pubkeys) != len(messages):
+            return False
+        sig_aff = _sig_to_checked_point(signature)
+        pairs = []
+        for pk, msg in zip(pubkeys, messages):
+            pk_aff = _key_validate_point(pk)
+            h = ec_to_affine(hash_to_g2(bytes(msg), DST))
+            pairs.append((pk_aff, h))
+        neg_gen = ec_to_affine(ec_neg(G1_GEN))
+        pairs.append((neg_gen, sig_aff))
+        return multi_pairing(pairs) == Fq12.one()
+    except Exception:
+        return False
+
+
+@only_with_bls(alt_return=True)
+def FastAggregateVerify(pubkeys: Sequence[bytes], message: bytes, signature: bytes) -> bool:
+    try:
+        if len(pubkeys) == 0:
+            return False
+        if _backend == "tpu":
+            from ..ops import bls_backend as tpu_backend
+
+            return tpu_backend.fast_aggregate_verify(pubkeys, message, signature)
+        agg = None
+        for pk in pubkeys:
+            agg = ec_add(agg, ec_from_affine(_key_validate_point(pk)))
+        if agg is None:
+            return False
+        sig_aff = _sig_to_checked_point(signature)
+        return _core_verify(ec_to_affine(agg), bytes(message), sig_aff)
+    except Exception:
+        return False
+
+
+@only_with_bls(alt_return=STUB_SIGNATURE)
+def Aggregate(signatures: Sequence[bytes]) -> bytes:
+    if len(signatures) == 0:
+        raise ValueError("Aggregate requires at least one signature")
+    acc = None
+    for sig in signatures:
+        aff = g2_from_bytes(bytes(sig))
+        acc = ec_add(acc, ec_from_affine(aff) if aff is not None else None)
+    return g2_to_bytes(ec_to_affine(acc))
+
+
+@only_with_bls(alt_return=STUB_SIGNATURE)
+def Sign(SK: int, message: bytes) -> bytes:
+    sk = int(SK)
+    if not 0 < sk < R:
+        raise ValueError("invalid secret key")
+    h = hash_to_g2(bytes(message), DST)
+    return g2_to_bytes(ec_to_affine(ec_mul(h, sk)))
+
+
+@only_with_bls(alt_return=STUB_PUBKEY)
+def SkToPk(SK: int) -> bytes:
+    sk = int(SK)
+    if not 0 < sk < R:
+        raise ValueError("invalid secret key")
+    return g1_to_bytes(ec_to_affine(ec_mul(G1_GEN, sk)))
+
+
+@only_with_bls(alt_return=STUB_PUBKEY)
+def AggregatePKs(pubkeys: Sequence[bytes]) -> bytes:
+    """Aggregate public keys with per-key KeyValidate
+    (reference: utils/bls.py:95-103)."""
+    assert len(pubkeys) > 0
+    acc = None
+    for pk in pubkeys:
+        acc = ec_add(acc, ec_from_affine(_key_validate_point(pk)))
+    return g1_to_bytes(ec_to_affine(acc))
+
+
+@only_with_bls(alt_return=True)
+def pairing_check(pairs) -> bool:
+    """prod e(P_i, Q_i) == 1 over ((g1 bytes-free affine), (g2 affine)) pairs —
+    used by the sharding spec's KZG degree checks."""
+    return multi_pairing(pairs) == Fq12.one()

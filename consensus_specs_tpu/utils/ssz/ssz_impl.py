@@ -1,0 +1,27 @@
+"""SSZ facade: serialize / hash_tree_root / copy / uint_to_bytes.
+
+(reference: tests/core/pyspec/eth2spec/utils/ssz/ssz_impl.py:8-25)
+"""
+from typing import TypeVar
+
+from .ssz_typing import View, uint
+
+V = TypeVar("V", bound=View)
+
+
+def serialize(obj: View) -> bytes:
+    return obj.encode_bytes()
+
+
+def hash_tree_root(obj: View) -> "bytes":
+    from .ssz_typing import Bytes32
+
+    return Bytes32(obj.hash_tree_root())
+
+
+def uint_to_bytes(n: uint) -> bytes:
+    return n.encode_bytes()
+
+
+def copy(obj: V) -> V:
+    return obj.copy()

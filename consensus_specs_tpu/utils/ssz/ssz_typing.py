@@ -1,0 +1,1035 @@
+"""SSZ type algebra + merkleization engine.
+
+Ground-up replacement for the reference's external `remerkleable` dependency
+(reference: tests/core/pyspec/eth2spec/utils/ssz/ssz_typing.py:4-13 re-exports;
+semantics per /root/reference/ssz/simple-serialize.md:105-249).
+
+Types: uintN, boolean, Container, Vector[T, N], List[T, N], Bitvector[N],
+Bitlist[N], ByteVector[N] (Bytes1/4/20/32/48/96...), ByteList[N], Union.
+
+Semantics notes (match remerkleable-backed reference behavior):
+- uintN arithmetic returns the same type and raises on over/underflow
+  (spec safety property, reference specs/phase0/beacon-chain.md:1236 note).
+- Assigning a composite value INTO a container/list stores a deep copy
+  (snapshot semantics, like remerkleable's persistent backing), while reads
+  alias, so `state.validators[i].exit_epoch = e` mutates the state.
+"""
+from __future__ import annotations
+
+import io
+from hashlib import sha256
+from typing import Any, Dict, Optional, Sequence, Tuple, Type
+
+BYTES_PER_CHUNK = 32
+BITS_PER_BYTE = 8
+
+# ---------------------------------------------------------------------------
+# zero-hash table + merkleize core (reference: utils/merkle_minimal.py:7-89)
+# ---------------------------------------------------------------------------
+
+ZERO_HASHES = [b"\x00" * 32]
+for _ in range(64):
+    ZERO_HASHES.append(sha256(ZERO_HASHES[-1] + ZERO_HASHES[-1]).digest())
+
+
+def next_power_of_two(v: int) -> int:
+    if v <= 1:
+        return 1
+    return 1 << (v - 1).bit_length()
+
+
+def merkleize_chunks(chunks: Sequence[bytes], limit: Optional[int] = None) -> bytes:
+    """Merkleize 32-byte chunks, padding with zero-chunks up to next_pow2(limit or count)."""
+    count = len(chunks)
+    if limit is None:
+        limit = count
+    if count > limit:
+        raise ValueError(f"merkleize: {count} chunks exceeds limit {limit}")
+    width = next_power_of_two(limit)
+    depth = (width - 1).bit_length()
+    if count == 0:
+        return ZERO_HASHES[depth]
+    layer = list(chunks)
+    for level in range(depth):
+        nxt = []
+        odd = len(layer) % 2 == 1
+        for i in range(len(layer) // 2):
+            nxt.append(sha256(layer[2 * i] + layer[2 * i + 1]).digest())
+        if odd:
+            nxt.append(sha256(layer[-1] + ZERO_HASHES[level]).digest())
+        layer = nxt
+    return layer[0]
+
+
+def mix_in_length(root: bytes, length: int) -> bytes:
+    return sha256(root + length.to_bytes(32, "little")).digest()
+
+
+def mix_in_selector(root: bytes, selector: int) -> bytes:
+    return sha256(root + selector.to_bytes(32, "little")).digest()
+
+
+def pack_bytes_into_chunks(data: bytes) -> Tuple[bytes, ...]:
+    if len(data) == 0:
+        return ()
+    pad = (-len(data)) % BYTES_PER_CHUNK
+    data = data + b"\x00" * pad
+    return tuple(data[i : i + 32] for i in range(0, len(data), 32))
+
+
+# ---------------------------------------------------------------------------
+# base View
+# ---------------------------------------------------------------------------
+
+
+class View:
+    """Base of all SSZ values."""
+
+    @classmethod
+    def is_fixed_byte_length(cls) -> bool:
+        raise NotImplementedError
+
+    @classmethod
+    def type_byte_length(cls) -> int:
+        raise NotImplementedError  # only for fixed-size types
+
+    @classmethod
+    def default(cls) -> "View":
+        return cls()
+
+    @classmethod
+    def coerce_view(cls, value: Any) -> "View":
+        if isinstance(value, cls):
+            return value
+        return cls(value)
+
+    def encode_bytes(self) -> bytes:
+        raise NotImplementedError
+
+    @classmethod
+    def decode_bytes(cls, data: bytes) -> "View":
+        raise NotImplementedError
+
+    def hash_tree_root(self) -> bytes:
+        raise NotImplementedError
+
+    def copy(self):
+        import copy as _copy
+
+        return _copy.deepcopy(self)
+
+
+def is_fixed_size(typ: Type[View]) -> bool:
+    return typ.is_fixed_byte_length()
+
+
+# ---------------------------------------------------------------------------
+# basic types
+# ---------------------------------------------------------------------------
+
+
+class uint(int, View):
+    TYPE_BYTE_LENGTH = 0
+
+    def __new__(cls, value: int = 0):
+        if isinstance(value, bytes):
+            raise ValueError("uint from bytes not allowed; use decode_bytes")
+        v = int(value)
+        if v < 0 or v >= (1 << (cls.TYPE_BYTE_LENGTH * 8)):
+            raise ValueError(f"{cls.__name__} out of range: {v}")
+        return super().__new__(cls, v)
+
+    @classmethod
+    def is_fixed_byte_length(cls) -> bool:
+        return True
+
+    @classmethod
+    def type_byte_length(cls) -> int:
+        return cls.TYPE_BYTE_LENGTH
+
+    def encode_bytes(self) -> bytes:
+        return int(self).to_bytes(self.TYPE_BYTE_LENGTH, "little")
+
+    @classmethod
+    def decode_bytes(cls, data: bytes) -> "uint":
+        if len(data) != cls.TYPE_BYTE_LENGTH:
+            raise ValueError(f"{cls.__name__}: wrong byte length {len(data)}")
+        return cls(int.from_bytes(data, "little"))
+
+    def hash_tree_root(self) -> bytes:
+        return self.encode_bytes().ljust(32, b"\x00")
+
+    # checked arithmetic: result stays in-type, raises on out-of-range
+    def _wrap(self, v: int) -> "uint":
+        return type(self)(v)
+
+    def __add__(self, o):
+        return self._wrap(int(self) + int(o))
+
+    def __radd__(self, o):
+        return self._wrap(int(o) + int(self))
+
+    def __sub__(self, o):
+        return self._wrap(int(self) - int(o))
+
+    def __rsub__(self, o):
+        return self._wrap(int(o) - int(self))
+
+    def __mul__(self, o):
+        return self._wrap(int(self) * int(o))
+
+    def __rmul__(self, o):
+        return self._wrap(int(o) * int(self))
+
+    def __floordiv__(self, o):
+        return self._wrap(int(self) // int(o))
+
+    def __rfloordiv__(self, o):
+        return self._wrap(int(o) // int(self))
+
+    def __mod__(self, o):
+        return self._wrap(int(self) % int(o))
+
+    def __rmod__(self, o):
+        return self._wrap(int(o) % int(self))
+
+    def __pow__(self, o, mod=None):
+        return self._wrap(pow(int(self), int(o), mod))
+
+    def __lshift__(self, o):
+        return self._wrap(int(self) << int(o))
+
+    def __rshift__(self, o):
+        return self._wrap(int(self) >> int(o))
+
+    def __and__(self, o):
+        return self._wrap(int(self) & int(o))
+
+    def __or__(self, o):
+        return self._wrap(int(self) | int(o))
+
+    def __xor__(self, o):
+        return self._wrap(int(self) ^ int(o))
+
+    def __neg__(self):
+        return self._wrap(-int(self))
+
+    def __hash__(self):
+        return int.__hash__(self)
+
+    def __repr__(self):
+        return f"{type(self).__name__}({int(self)})"
+
+
+class uint8(uint):
+    TYPE_BYTE_LENGTH = 1
+
+
+class uint16(uint):
+    TYPE_BYTE_LENGTH = 2
+
+
+class uint32(uint):
+    TYPE_BYTE_LENGTH = 4
+
+
+class uint64(uint):
+    TYPE_BYTE_LENGTH = 8
+
+
+class uint128(uint):
+    TYPE_BYTE_LENGTH = 16
+
+
+class uint256(uint):
+    TYPE_BYTE_LENGTH = 32
+
+
+byte = uint8
+
+
+class boolean(int, View):
+    def __new__(cls, value: int = 0):
+        v = int(value)
+        if v not in (0, 1):
+            raise ValueError(f"boolean out of range: {v}")
+        return super().__new__(cls, v)
+
+    @classmethod
+    def is_fixed_byte_length(cls) -> bool:
+        return True
+
+    @classmethod
+    def type_byte_length(cls) -> int:
+        return 1
+
+    def encode_bytes(self) -> bytes:
+        return bytes([int(self)])
+
+    @classmethod
+    def decode_bytes(cls, data: bytes) -> "boolean":
+        if len(data) != 1 or data[0] not in (0, 1):
+            raise ValueError(f"boolean: invalid encoding {data!r}")
+        return cls(data[0])
+
+    def hash_tree_root(self) -> bytes:
+        return self.encode_bytes().ljust(32, b"\x00")
+
+    def __repr__(self):
+        return f"boolean({int(self)})"
+
+    def __hash__(self):
+        return int.__hash__(self)
+
+
+def is_basic_type(typ: Type[View]) -> bool:
+    return isinstance(typ, type) and issubclass(typ, (uint, boolean))
+
+
+# ---------------------------------------------------------------------------
+# byte vectors / byte lists
+# ---------------------------------------------------------------------------
+
+_byte_vector_cache: Dict[int, type] = {}
+_byte_list_cache: Dict[int, type] = {}
+
+
+class ByteVector(bytes, View):
+    LENGTH = 0
+
+    def __class_getitem__(cls, length: int) -> type:
+        if length not in _byte_vector_cache:
+            _byte_vector_cache[length] = type(
+                f"ByteVector[{length}]", (ByteVector,), {"LENGTH": length}
+            )
+        return _byte_vector_cache[length]
+
+    def __new__(cls, value: bytes = None):
+        if cls.LENGTH == 0 and cls is ByteVector:
+            raise TypeError("raw ByteVector is not instantiable; parameterize it")
+        if value is None:
+            value = b"\x00" * cls.LENGTH
+        if isinstance(value, str):
+            if value.startswith("0x"):
+                value = bytes.fromhex(value[2:])
+            else:
+                value = bytes.fromhex(value)
+        value = bytes(value)
+        if len(value) != cls.LENGTH:
+            raise ValueError(f"{cls.__name__}: expected {cls.LENGTH} bytes, got {len(value)}")
+        return super().__new__(cls, value)
+
+    @classmethod
+    def is_fixed_byte_length(cls) -> bool:
+        return True
+
+    @classmethod
+    def type_byte_length(cls) -> int:
+        return cls.LENGTH
+
+    def encode_bytes(self) -> bytes:
+        return bytes(self)
+
+    @classmethod
+    def decode_bytes(cls, data: bytes) -> "ByteVector":
+        return cls(data)
+
+    def hash_tree_root(self) -> bytes:
+        return merkleize_chunks(pack_bytes_into_chunks(bytes(self)), limit=chunk_count(type(self)))
+
+    def __repr__(self):
+        return f"{type(self).__name__}(0x{bytes(self).hex()})"
+
+
+class ByteList(bytes, View):
+    LIMIT = 0
+
+    def __class_getitem__(cls, limit: int) -> type:
+        if limit not in _byte_list_cache:
+            _byte_list_cache[limit] = type(f"ByteList[{limit}]", (ByteList,), {"LIMIT": limit})
+        return _byte_list_cache[limit]
+
+    def __new__(cls, value: bytes = b""):
+        if isinstance(value, str) and value.startswith("0x"):
+            value = bytes.fromhex(value[2:])
+        value = bytes(value)
+        if len(value) > cls.LIMIT:
+            raise ValueError(f"{cls.__name__}: {len(value)} bytes exceeds limit {cls.LIMIT}")
+        return super().__new__(cls, value)
+
+    @classmethod
+    def is_fixed_byte_length(cls) -> bool:
+        return False
+
+    def encode_bytes(self) -> bytes:
+        return bytes(self)
+
+    @classmethod
+    def decode_bytes(cls, data: bytes) -> "ByteList":
+        return cls(data)
+
+    def hash_tree_root(self) -> bytes:
+        root = merkleize_chunks(
+            pack_bytes_into_chunks(bytes(self)), limit=(self.LIMIT + 31) // 32
+        )
+        return mix_in_length(root, len(self))
+
+    def __repr__(self):
+        return f"{type(self).__name__}(0x{bytes(self).hex()})"
+
+
+# common aliases (reference: utils/ssz/ssz_typing.py + spec custom types)
+Bytes1 = ByteVector[1]
+Bytes4 = ByteVector[4]
+Bytes8 = ByteVector[8]
+Bytes20 = ByteVector[20]
+Bytes32 = ByteVector[32]
+Bytes48 = ByteVector[48]
+Bytes96 = ByteVector[96]
+
+
+# ---------------------------------------------------------------------------
+# bitfields
+# ---------------------------------------------------------------------------
+
+_bitvector_cache: Dict[int, type] = {}
+_bitlist_cache: Dict[int, type] = {}
+
+
+def _bits_to_bytes(bits: Sequence[bool]) -> bytes:
+    out = bytearray((len(bits) + 7) // 8)
+    for i, b in enumerate(bits):
+        if b:
+            out[i // 8] |= 1 << (i % 8)
+    return bytes(out)
+
+
+class Bitvector(View):
+    LENGTH = 0
+
+    def __class_getitem__(cls, length: int) -> type:
+        if length not in _bitvector_cache:
+            _bitvector_cache[length] = type(
+                f"Bitvector[{length}]", (Bitvector,), {"LENGTH": length}
+            )
+        return _bitvector_cache[length]
+
+    def __init__(self, *args):
+        if self.LENGTH == 0 and type(self) is Bitvector:
+            raise TypeError("raw Bitvector is not instantiable; parameterize it")
+        if len(args) == 1 and isinstance(args[0], (list, tuple, Bitvector)):
+            bits = [bool(b) for b in args[0]]
+        else:
+            bits = [bool(b) for b in args]
+        if len(bits) == 0:
+            bits = [False] * self.LENGTH
+        if len(bits) != self.LENGTH:
+            raise ValueError(f"{type(self).__name__}: expected {self.LENGTH} bits, got {len(bits)}")
+        self._bits = bits
+
+    @classmethod
+    def is_fixed_byte_length(cls) -> bool:
+        return True
+
+    @classmethod
+    def type_byte_length(cls) -> int:
+        return (cls.LENGTH + 7) // 8
+
+    def __len__(self):
+        return self.LENGTH
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return self._bits[i]
+        return self._bits[i]
+
+    def __setitem__(self, i, v):
+        self._bits[i] = bool(v)
+
+    def __iter__(self):
+        return iter(self._bits)
+
+    def __eq__(self, other):
+        if isinstance(other, Bitvector):
+            return self.LENGTH == other.LENGTH and self._bits == other._bits
+        if isinstance(other, (list, tuple)):
+            return self._bits == [bool(b) for b in other]
+        return NotImplemented
+
+    def __hash__(self):
+        return hash((self.LENGTH, tuple(self._bits)))
+
+    def encode_bytes(self) -> bytes:
+        return _bits_to_bytes(self._bits)
+
+    @classmethod
+    def decode_bytes(cls, data: bytes) -> "Bitvector":
+        if len(data) != cls.type_byte_length():
+            raise ValueError(f"{cls.__name__}: wrong byte length {len(data)}")
+        bits = [bool((data[i // 8] >> (i % 8)) & 1) for i in range(cls.LENGTH)]
+        # check padding bits are zero
+        if cls.LENGTH % 8 != 0:
+            if data[-1] >> (cls.LENGTH % 8) != 0:
+                raise ValueError(f"{cls.__name__}: nonzero padding bits")
+        return cls(bits)
+
+    def hash_tree_root(self) -> bytes:
+        return merkleize_chunks(
+            pack_bytes_into_chunks(self.encode_bytes()), limit=(self.LENGTH + 255) // 256
+        )
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._bits})"
+
+
+class Bitlist(View):
+    LIMIT = 0
+
+    def __class_getitem__(cls, limit: int) -> type:
+        if limit not in _bitlist_cache:
+            _bitlist_cache[limit] = type(f"Bitlist[{limit}]", (Bitlist,), {"LIMIT": limit})
+        return _bitlist_cache[limit]
+
+    def __init__(self, *args):
+        if len(args) == 1 and isinstance(args[0], (list, tuple, Bitlist)):
+            bits = [bool(b) for b in args[0]]
+        else:
+            bits = [bool(b) for b in args]
+        if len(bits) > self.LIMIT:
+            raise ValueError(f"{type(self).__name__}: {len(bits)} bits exceeds limit {self.LIMIT}")
+        self._bits = bits
+
+    @classmethod
+    def is_fixed_byte_length(cls) -> bool:
+        return False
+
+    def __len__(self):
+        return len(self._bits)
+
+    def __getitem__(self, i):
+        return self._bits[i]
+
+    def __setitem__(self, i, v):
+        self._bits[i] = bool(v)
+
+    def __iter__(self):
+        return iter(self._bits)
+
+    def append(self, v):
+        if len(self._bits) + 1 > self.LIMIT:
+            raise ValueError(f"{type(self).__name__}: append exceeds limit")
+        self._bits.append(bool(v))
+
+    def __eq__(self, other):
+        if isinstance(other, Bitlist):
+            return self.LIMIT == other.LIMIT and self._bits == other._bits
+        if isinstance(other, (list, tuple)):
+            return self._bits == [bool(b) for b in other]
+        return NotImplemented
+
+    def __hash__(self):
+        return hash((self.LIMIT, tuple(self._bits)))
+
+    def encode_bytes(self) -> bytes:
+        # serialized form includes the length-delimiting bit
+        as_bytes = bytearray(_bits_to_bytes(self._bits + [True]))
+        return bytes(as_bytes)
+
+    @classmethod
+    def decode_bytes(cls, data: bytes) -> "Bitlist":
+        if len(data) == 0:
+            raise ValueError(f"{cls.__name__}: empty encoding")
+        if data[-1] == 0:
+            raise ValueError(f"{cls.__name__}: missing delimiter bit")
+        total_bits = (len(data) - 1) * 8 + data[-1].bit_length() - 1
+        if total_bits > cls.LIMIT:
+            raise ValueError(f"{cls.__name__}: {total_bits} bits exceeds limit {cls.LIMIT}")
+        bits = [bool((data[i // 8] >> (i % 8)) & 1) for i in range(total_bits)]
+        return cls(bits)
+
+    def hash_tree_root(self) -> bytes:
+        root = merkleize_chunks(
+            pack_bytes_into_chunks(_bits_to_bytes(self._bits)), limit=(self.LIMIT + 255) // 256
+        )
+        return mix_in_length(root, len(self._bits))
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._bits})"
+
+
+# ---------------------------------------------------------------------------
+# Vector / List
+# ---------------------------------------------------------------------------
+
+_vector_cache: Dict[Tuple[type, int], type] = {}
+_list_cache: Dict[Tuple[type, int], type] = {}
+
+
+def _coerce_elem(typ: Type[View], v: Any) -> View:
+    if type(v) is typ:
+        return v
+    if isinstance(v, typ) and is_basic_type(typ):
+        return v  # subclass of a basic type (e.g. Slot for uint64) keeps identity
+    return typ.coerce_view(v) if not isinstance(v, typ) else v
+
+
+def _store_elem(typ: Type[View], v: Any) -> View:
+    """Coerce + snapshot a value being stored into a composite."""
+    v = _coerce_elem(typ, v)
+    if not is_basic_type(typ) and not isinstance(v, bytes) and not isinstance(typ, type(None)):
+        if isinstance(v, (Container, ComplexSeries, Bitvector, Bitlist, Union)):
+            v = v.copy()
+    return v
+
+
+class ComplexSeries(View):
+    """Shared implementation of Vector/List of non-byte elements."""
+
+    ELEM_TYPE: Type[View] = None  # type: ignore
+
+    def __init__(self, *args):
+        if len(args) == 1 and isinstance(args[0], (list, tuple)) and not isinstance(
+            args[0], ByteVector
+        ):
+            elems = list(args[0])
+        elif len(args) == 1 and isinstance(args[0], ComplexSeries):
+            elems = list(args[0])
+        else:
+            elems = list(args)
+        self._elems = [_store_elem(self.ELEM_TYPE, e) for e in elems]
+        self._check_init_length()
+
+    def _check_init_length(self):
+        raise NotImplementedError
+
+    def __len__(self):
+        return len(self._elems)
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return self._elems[i]
+        return self._elems[int(i)]
+
+    def __setitem__(self, i, v):
+        self._elems[int(i)] = _store_elem(self.ELEM_TYPE, v)
+
+    def __iter__(self):
+        return iter(self._elems)
+
+    def __contains__(self, v):
+        return v in self._elems
+
+    def count(self, v):
+        return sum(1 for e in self._elems if e == v)
+
+    def index(self, v):
+        for i, e in enumerate(self._elems):
+            if e == v:
+                return i
+        raise ValueError(f"{v!r} not in series")
+
+    def __eq__(self, other):
+        if isinstance(other, ComplexSeries):
+            return (
+                self.ELEM_TYPE is other.ELEM_TYPE
+                and type(self).__name__.split("[")[0] == type(other).__name__.split("[")[0]
+                and self._elems == other._elems
+            )
+        if isinstance(other, (list, tuple)):
+            return self._elems == list(other)
+        return NotImplemented
+
+    def __hash__(self):
+        return hash(self.hash_tree_root())
+
+    def _chunks(self) -> Tuple[bytes, ...]:
+        if is_basic_type(self.ELEM_TYPE):
+            return pack_bytes_into_chunks(b"".join(e.encode_bytes() for e in self._elems))
+        return tuple(e.hash_tree_root() for e in self._elems)
+
+    def encode_bytes(self) -> bytes:
+        return _serialize_series(self.ELEM_TYPE, self._elems)
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._elems})"
+
+
+class Vector(ComplexSeries):
+    LENGTH = 0
+
+    def __class_getitem__(cls, params) -> type:
+        elem_type, length = params
+        key = (elem_type, length)
+        if key not in _vector_cache:
+            _vector_cache[key] = type(
+                f"Vector[{elem_type.__name__},{length}]",
+                (Vector,),
+                {"ELEM_TYPE": elem_type, "LENGTH": length},
+            )
+        return _vector_cache[key]
+
+    def _check_init_length(self):
+        if len(self._elems) == 0:
+            self._elems = [self.ELEM_TYPE.default() for _ in range(self.LENGTH)]
+        if len(self._elems) != self.LENGTH:
+            raise ValueError(
+                f"{type(self).__name__}: expected {self.LENGTH} elements, got {len(self._elems)}"
+            )
+
+    @classmethod
+    def is_fixed_byte_length(cls) -> bool:
+        return cls.ELEM_TYPE.is_fixed_byte_length()
+
+    @classmethod
+    def type_byte_length(cls) -> int:
+        return cls.ELEM_TYPE.type_byte_length() * cls.LENGTH
+
+    @classmethod
+    def decode_bytes(cls, data: bytes) -> "Vector":
+        elems = _deserialize_series(cls.ELEM_TYPE, data, exact_count=cls.LENGTH)
+        return cls(elems)
+
+    def hash_tree_root(self) -> bytes:
+        return merkleize_chunks(self._chunks(), limit=chunk_count(type(self)))
+
+
+class List(ComplexSeries):
+    LIMIT = 0
+
+    def __class_getitem__(cls, params) -> type:
+        elem_type, limit = params
+        limit = int(limit)
+        key = (elem_type, limit)
+        if key not in _list_cache:
+            _list_cache[key] = type(
+                f"List[{elem_type.__name__},{limit}]",
+                (List,),
+                {"ELEM_TYPE": elem_type, "LIMIT": limit},
+            )
+        return _list_cache[key]
+
+    def _check_init_length(self):
+        if len(self._elems) > self.LIMIT:
+            raise ValueError(
+                f"{type(self).__name__}: {len(self._elems)} elements exceeds limit {self.LIMIT}"
+            )
+
+    @classmethod
+    def is_fixed_byte_length(cls) -> bool:
+        return False
+
+    def append(self, v):
+        if len(self._elems) + 1 > self.LIMIT:
+            raise ValueError(f"{type(self).__name__}: append exceeds limit {self.LIMIT}")
+        self._elems.append(_store_elem(self.ELEM_TYPE, v))
+
+    def pop(self, i=-1):
+        return self._elems.pop(i)
+
+    @classmethod
+    def decode_bytes(cls, data: bytes) -> "List":
+        elems = _deserialize_series(cls.ELEM_TYPE, data, limit=cls.LIMIT)
+        return cls(elems)
+
+    def hash_tree_root(self) -> bytes:
+        root = merkleize_chunks(self._chunks(), limit=chunk_count(type(self)))
+        return mix_in_length(root, len(self._elems))
+
+
+# ---------------------------------------------------------------------------
+# Container
+# ---------------------------------------------------------------------------
+
+
+class Container(View):
+    _field_types: "Dict[str, Type[View]]" = {}
+
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        fields: Dict[str, Type[View]] = {}
+        for base in reversed(cls.__mro__):
+            anns = base.__dict__.get("__annotations__", {})
+            for name, typ in anns.items():
+                if name.startswith("_"):
+                    continue
+                fields[name] = typ
+        cls._field_types = fields
+
+    @classmethod
+    def fields(cls) -> "Dict[str, Type[View]]":
+        return cls._field_types
+
+    def __init__(self, **kwargs):
+        for name, typ in self._field_types.items():
+            if name in kwargs:
+                object.__setattr__(self, name, _store_elem(typ, kwargs.pop(name)))
+            else:
+                object.__setattr__(self, name, typ.default())
+        if kwargs:
+            raise TypeError(f"{type(self).__name__}: unknown fields {list(kwargs)}")
+
+    def __setattr__(self, name, value):
+        typ = self._field_types.get(name)
+        if typ is None:
+            raise AttributeError(f"{type(self).__name__} has no SSZ field {name!r}")
+        object.__setattr__(self, name, _store_elem(typ, value))
+
+    def __eq__(self, other):
+        if type(other) is not type(self):
+            if isinstance(other, Container) and other._field_types == self._field_types:
+                pass  # same shape (e.g. fork-specific aliases) — compare by value
+            else:
+                return NotImplemented
+        return all(
+            getattr(self, n) == getattr(other, n) for n in self._field_types
+        )
+
+    def __hash__(self):
+        return hash(self.hash_tree_root())
+
+    @classmethod
+    def is_fixed_byte_length(cls) -> bool:
+        return all(t.is_fixed_byte_length() for t in cls._field_types.values())
+
+    @classmethod
+    def type_byte_length(cls) -> int:
+        return sum(t.type_byte_length() for t in cls._field_types.values())
+
+    def encode_bytes(self) -> bytes:
+        fixed_parts = []
+        variable_parts = []
+        for name, typ in self._field_types.items():
+            v = getattr(self, name)
+            if typ.is_fixed_byte_length():
+                fixed_parts.append(v.encode_bytes())
+                variable_parts.append(b"")
+            else:
+                fixed_parts.append(None)
+                variable_parts.append(v.encode_bytes())
+        fixed_len = sum(len(p) if p is not None else 4 for p in fixed_parts)
+        offsets = []
+        acc = fixed_len
+        for vp, fp in zip(variable_parts, fixed_parts):
+            if fp is None:
+                offsets.append(acc)
+                acc += len(vp)
+        out = io.BytesIO()
+        oi = 0
+        for fp in fixed_parts:
+            if fp is None:
+                out.write(offsets[oi].to_bytes(4, "little"))
+                oi += 1
+            else:
+                out.write(fp)
+        for vp in variable_parts:
+            out.write(vp)
+        return out.getvalue()
+
+    @classmethod
+    def decode_bytes(cls, data: bytes) -> "Container":
+        names = list(cls._field_types)
+        types = list(cls._field_types.values())
+        fixed_len = sum(t.type_byte_length() if t.is_fixed_byte_length() else 4 for t in types)
+        if cls.is_fixed_byte_length():
+            if len(data) != fixed_len:
+                raise ValueError(f"{cls.__name__}: wrong length {len(data)}, expected {fixed_len}")
+        elif len(data) < fixed_len:
+            raise ValueError(f"{cls.__name__}: truncated ({len(data)} < {fixed_len})")
+        values: Dict[str, View] = {}
+        offsets = []  # (field index, offset)
+        pos = 0
+        for name, typ in zip(names, types):
+            if typ.is_fixed_byte_length():
+                n = typ.type_byte_length()
+                values[name] = typ.decode_bytes(data[pos : pos + n])
+                pos += n
+            else:
+                offsets.append((name, typ, int.from_bytes(data[pos : pos + 4], "little")))
+                pos += 4
+        if offsets:
+            if offsets[0][2] != fixed_len:
+                raise ValueError(f"{cls.__name__}: first offset {offsets[0][2]} != {fixed_len}")
+            bounds = [o for (_, _, o) in offsets] + [len(data)]
+            for i, (name, typ, off) in enumerate(offsets):
+                end = bounds[i + 1]
+                if off > end or end > len(data):
+                    raise ValueError(f"{cls.__name__}: bad offsets")
+                values[name] = typ.decode_bytes(data[off:end])
+        obj = cls.__new__(cls)
+        for name, typ in cls._field_types.items():
+            object.__setattr__(obj, name, values[name])
+        return obj
+
+    def hash_tree_root(self) -> bytes:
+        chunks = tuple(getattr(self, n).hash_tree_root() for n in self._field_types)
+        return merkleize_chunks(chunks, limit=len(chunks) if chunks else 1)
+
+    def __repr__(self):
+        inner = ", ".join(f"{n}={getattr(self, n)!r}" for n in self._field_types)
+        return f"{type(self).__name__}({inner})"
+
+
+# ---------------------------------------------------------------------------
+# Union
+# ---------------------------------------------------------------------------
+
+_union_cache: Dict[tuple, type] = {}
+
+
+class Union(View):
+    OPTIONS: Tuple[Optional[Type[View]], ...] = ()
+
+    def __class_getitem__(cls, params) -> type:
+        if not isinstance(params, tuple):
+            params = (params,)
+        if params not in _union_cache:
+            _union_cache[params] = type(
+                f"Union[{','.join('None' if p is None else p.__name__ for p in params)}]",
+                (Union,),
+                {"OPTIONS": params},
+            )
+        return _union_cache[params]
+
+    def __init__(self, selector: int = 0, value: Any = None):
+        if selector < 0 or selector >= len(self.OPTIONS):
+            raise ValueError(f"union selector {selector} out of range")
+        typ = self.OPTIONS[selector]
+        if typ is None:
+            if value is not None:
+                raise ValueError("union None option takes no value")
+            self._value = None
+        else:
+            self._value = _store_elem(typ, value if value is not None else typ.default())
+        self._selector = selector
+
+    @property
+    def selector(self) -> int:
+        return self._selector
+
+    @property
+    def value(self):
+        return self._value
+
+    @classmethod
+    def is_fixed_byte_length(cls) -> bool:
+        return False
+
+    def __eq__(self, other):
+        if not isinstance(other, Union):
+            return NotImplemented
+        return (
+            self.OPTIONS == other.OPTIONS
+            and self._selector == other._selector
+            and self._value == other._value
+        )
+
+    def __hash__(self):
+        return hash(self.hash_tree_root())
+
+    def encode_bytes(self) -> bytes:
+        body = b"" if self._value is None else self._value.encode_bytes()
+        return bytes([self._selector]) + body
+
+    @classmethod
+    def decode_bytes(cls, data: bytes) -> "Union":
+        if len(data) == 0:
+            raise ValueError("union: empty encoding")
+        selector = data[0]
+        if selector >= len(cls.OPTIONS):
+            raise ValueError(f"union: selector {selector} out of range")
+        typ = cls.OPTIONS[selector]
+        if typ is None:
+            if len(data) != 1:
+                raise ValueError("union: None option with body")
+            return cls(0)
+        return cls(selector, typ.decode_bytes(data[1:]))
+
+    def hash_tree_root(self) -> bytes:
+        root = b"\x00" * 32 if self._value is None else self._value.hash_tree_root()
+        return mix_in_selector(root, self._selector)
+
+    def __repr__(self):
+        return f"{type(self).__name__}(selector={self._selector}, value={self._value!r})"
+
+
+# ---------------------------------------------------------------------------
+# shared serialization helpers
+# ---------------------------------------------------------------------------
+
+
+def _serialize_series(elem_type: Type[View], elems: Sequence[View]) -> bytes:
+    if elem_type.is_fixed_byte_length():
+        return b"".join(e.encode_bytes() for e in elems)
+    parts = [e.encode_bytes() for e in elems]
+    offsets = []
+    acc = 4 * len(parts)
+    for p in parts:
+        offsets.append(acc)
+        acc += len(p)
+    return b"".join(o.to_bytes(4, "little") for o in offsets) + b"".join(parts)
+
+
+def _deserialize_series(
+    elem_type: Type[View],
+    data: bytes,
+    exact_count: Optional[int] = None,
+    limit: Optional[int] = None,
+) -> list:
+    if elem_type.is_fixed_byte_length():
+        n = elem_type.type_byte_length()
+        if len(data) % n != 0:
+            raise ValueError(f"series: length {len(data)} not divisible by element size {n}")
+        count = len(data) // n
+        if exact_count is not None and count != exact_count:
+            raise ValueError(f"series: expected {exact_count} elements, got {count}")
+        if limit is not None and count > limit:
+            raise ValueError(f"series: {count} elements exceeds limit {limit}")
+        return [elem_type.decode_bytes(data[i * n : (i + 1) * n]) for i in range(count)]
+    # variable-size elements: offset table
+    if len(data) == 0:
+        if exact_count not in (None, 0):
+            raise ValueError("series: empty data for non-empty vector")
+        return []
+    first = int.from_bytes(data[0:4], "little")
+    if first % 4 != 0 or first == 0:
+        raise ValueError(f"series: invalid first offset {first}")
+    count = first // 4
+    if exact_count is not None and count != exact_count:
+        raise ValueError(f"series: expected {exact_count} elements, got {count}")
+    if limit is not None and count > limit:
+        raise ValueError(f"series: {count} elements exceeds limit {limit}")
+    offs = [int.from_bytes(data[i * 4 : i * 4 + 4], "little") for i in range(count)]
+    offs.append(len(data))
+    if offs[0] != count * 4:
+        raise ValueError("series: first offset mismatch")
+    out = []
+    for i in range(count):
+        if offs[i] > offs[i + 1] or offs[i + 1] > len(data):
+            raise ValueError("series: bad offsets")
+        out.append(elem_type.decode_bytes(data[offs[i] : offs[i + 1]]))
+    return out
+
+
+def chunk_count(typ: Type[View]) -> int:
+    """Number of bottom-layer chunks for merkleization (ssz/simple-serialize.md:210-230)."""
+    if is_basic_type(typ):
+        return 1
+    if issubclass(typ, ByteVector):
+        return (typ.LENGTH + 31) // 32
+    if issubclass(typ, ByteList):
+        return (typ.LIMIT + 31) // 32
+    if issubclass(typ, Bitvector):
+        return (typ.LENGTH + 255) // 256
+    if issubclass(typ, Bitlist):
+        return (typ.LIMIT + 255) // 256
+    if issubclass(typ, Vector):
+        if is_basic_type(typ.ELEM_TYPE):
+            return (typ.LENGTH * typ.ELEM_TYPE.type_byte_length() + 31) // 32
+        return typ.LENGTH
+    if issubclass(typ, List):
+        if is_basic_type(typ.ELEM_TYPE):
+            return (typ.LIMIT * typ.ELEM_TYPE.type_byte_length() + 31) // 32
+        return typ.LIMIT
+    if issubclass(typ, Container):
+        return max(len(typ.fields()), 1)
+    raise TypeError(f"chunk_count: unsupported type {typ}")

@@ -1,0 +1,52 @@
+"""Test session config.
+
+Sets up a virtual 8-device CPU platform BEFORE jax is imported anywhere, so
+multi-chip sharding tests (mesh/pjit/shard_map) run without TPU hardware.
+Also wires the reference-style CLI flags (--preset/--fork/--disable-bls)
+(reference: tests/core/pyspec/eth2spec/test/conftest.py:30-93).
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--preset", action="store", type=str, default="minimal",
+        help="preset to run tests against: minimal or mainnet",
+    )
+    parser.addoption(
+        "--fork", action="append", type=str, default=None,
+        help="fork(s) to run tests against (repeatable)",
+    )
+    parser.addoption(
+        "--disable-bls", action="store_true", default=False,
+        help="disable BLS for tests that do not require it",
+    )
+    parser.addoption(
+        "--bls-type", action="store", type=str, default="py_ecc",
+        help="BLS backend: py_ecc (pure-python oracle) or tpu (JAX backend)",
+    )
+
+
+@pytest.fixture(autouse=True)
+def _configure_harness(request):
+    from consensus_specs_tpu.test import context
+    from consensus_specs_tpu.utils import bls
+
+    context.DEFAULT_TEST_PRESET = request.config.getoption("--preset")
+    forks = request.config.getoption("--fork")
+    context.DEFAULT_PYTEST_FORKS = set(forks) if forks else None
+    if request.config.getoption("--disable-bls"):
+        bls.bls_active = False
+    bls_type = request.config.getoption("--bls-type")
+    if bls_type == "tpu":
+        bls.use_tpu()
+    else:
+        bls.use_py_ecc()
+    yield

@@ -1,0 +1,209 @@
+"""BLS12-381 oracle tests: curve structure, pairing bilinearity, serialization,
+hash-to-curve self-consistency, and the IETF signature API.
+
+Modeled on the reference BLS generator's cross-check strategy
+(reference: tests/generators/bls/main.py).
+"""
+import pytest
+
+from consensus_specs_tpu.utils import bls
+from consensus_specs_tpu.utils.bls12_381 import (
+    Fq, Fq2, Fq12, G1_GEN, G2_GEN, P, R, B_G2, ec_add, ec_eq, ec_from_affine,
+    ec_mul, ec_neg, ec_to_affine, g1_from_bytes, g1_to_bytes, g2_from_bytes,
+    g2_to_bytes, hash_to_g2, is_on_curve_g1, is_on_curve_g2,
+    is_in_g2_subgroup, iso_map_g2, map_to_curve_sswu_g2, pairing,
+    hash_to_field_fq2, expand_message_xmd,
+)
+
+pytestmark = pytest.mark.bls  # crypto-heavy suite
+
+
+def test_generators_on_curve_and_order():
+    assert is_on_curve_g1(ec_to_affine(G1_GEN))
+    assert is_on_curve_g2(ec_to_affine(G2_GEN))
+    assert ec_mul(G1_GEN, R) is None
+    assert ec_mul(G2_GEN, R) is None
+
+
+def test_ec_group_laws_g1():
+    p2 = ec_mul(G1_GEN, 2)
+    assert ec_eq(ec_add(G1_GEN, G1_GEN), p2)
+    p5 = ec_mul(G1_GEN, 5)
+    assert ec_eq(ec_add(p2, ec_mul(G1_GEN, 3)), p5)
+    assert ec_add(p5, ec_neg(p5)) is None
+    assert ec_eq(ec_add(p5, None), p5)
+
+
+def test_g1_serialization_roundtrip():
+    for k in (1, 2, 3, 12345, R - 1):
+        pt = ec_to_affine(ec_mul(G1_GEN, k))
+        data = g1_to_bytes(pt)
+        assert len(data) == 48
+        back = g1_from_bytes(data)
+        assert back == pt
+    # infinity
+    inf_bytes = bytes([0xC0]) + b"\x00" * 47
+    assert g1_from_bytes(inf_bytes) is None
+    assert g1_to_bytes(None) == inf_bytes
+
+
+def test_g1_generator_known_compressed_encoding():
+    # well-known compressed encoding of the G1 generator
+    expected = bytes.fromhex(
+        "97f1d3a73197d7942695638c4fa9ac0fc3688c4f9774b905a14e3a3f171bac58"
+        "6c55e83ff97a1aeffb3af00adb22c6bb"
+    )
+    assert g1_to_bytes(ec_to_affine(G1_GEN)) == expected
+
+
+def test_g2_serialization_roundtrip():
+    for k in (1, 2, 7, 98765):
+        pt = ec_to_affine(ec_mul(G2_GEN, k))
+        data = g2_to_bytes(pt)
+        assert len(data) == 96
+        assert g2_from_bytes(data) == pt
+    inf = bytes([0xC0]) + b"\x00" * 95
+    assert g2_from_bytes(inf) is None
+    assert g2_to_bytes(None) == inf
+
+
+def test_invalid_encodings_rejected():
+    with pytest.raises(ValueError):
+        g1_from_bytes(b"\x00" * 48)  # compression bit unset
+    with pytest.raises(ValueError):
+        g1_from_bytes(bytes([0x80]) + b"\xff" * 47)  # x >= p
+    with pytest.raises(ValueError):
+        g1_from_bytes(bytes([0xE0]) + b"\x00" * 47)  # infinity with sign bit
+    with pytest.raises(ValueError):
+        g2_from_bytes(b"\x11" * 96)
+
+
+def test_fq2_sqrt():
+    a = Fq2(5, 7)
+    sq = a * a
+    root = sq.sqrt()
+    assert root is not None and root * root == sq
+
+
+def test_sswu_maps_to_isogenous_then_real_curve():
+    # SSWU output is on E'(A', B'); iso_map moves it onto E: y^2 = x^3 + 4(1+u).
+    # This check would fail if any of the 15 isogeny constants were wrong.
+    from consensus_specs_tpu.utils.bls12_381 import SSWU_A, SSWU_B
+
+    for seed in range(5):
+        u = Fq2(seed * 1234567 + 1, seed * 7654321 + 2)
+        x, y = map_to_curve_sswu_g2(u)
+        assert y * y == x * x * x + SSWU_A * x + SSWU_B
+        xi, yi = iso_map_g2(x, y)
+        assert yi * yi == xi * xi * xi + B_G2
+
+
+def test_hash_to_g2_in_subgroup_and_deterministic():
+    h1 = hash_to_g2(b"test message", bls.DST)
+    h2 = hash_to_g2(b"test message", bls.DST)
+    assert ec_eq(h1, h2)
+    assert is_on_curve_g2(ec_to_affine(h1))
+    assert is_in_g2_subgroup(h1)
+    h3 = hash_to_g2(b"different", bls.DST)
+    assert not ec_eq(h1, h3)
+
+
+def test_expand_message_xmd_length_and_determinism():
+    out = expand_message_xmd(b"msg", b"DST", 256)
+    assert len(out) == 256
+    assert out == expand_message_xmd(b"msg", b"DST", 256)
+    assert out[:32] != b"\x00" * 32
+
+
+def test_pairing_bilinearity():
+    e = pairing(ec_to_affine(G2_GEN), ec_to_affine(G1_GEN))
+    assert e != Fq12.one()
+    # e(aP, Q) == e(P, Q)^a
+    a, b = 5, 7
+    e_a = pairing(ec_to_affine(G2_GEN), ec_to_affine(ec_mul(G1_GEN, a)))
+    assert e_a == e.pow(a)
+    # e(aP, bQ) == e(P, Q)^(ab)
+    e_ab = pairing(ec_to_affine(ec_mul(G2_GEN, b)), ec_to_affine(ec_mul(G1_GEN, a)))
+    assert e_ab == e.pow(a * b)
+    # e(P, Q)^r == 1
+    assert e.pow(R) == Fq12.one()
+
+
+def test_sign_verify():
+    sk = 42
+    pk = bls.SkToPk(sk)
+    msg = b"\x12" * 32
+    sig = bls.Sign(sk, msg)
+    assert bls.Verify(pk, msg, sig)
+    assert not bls.Verify(pk, b"\x13" * 32, sig)
+    assert not bls.Verify(bls.SkToPk(43), msg, sig)
+    # tampered signature: invalid encodings return False (never raise)
+    assert not bls.Verify(pk, msg, b"\x00" * 96)
+    assert not bls.Verify(b"\x00" * 48, msg, sig)
+
+
+def test_zero_privkey_rejected():
+    with pytest.raises(ValueError):
+        bls.Sign(0, b"msg")
+    with pytest.raises(ValueError):
+        bls.SkToPk(0)
+
+
+def test_aggregate_and_fast_aggregate_verify():
+    msg = b"\x34" * 32
+    sks = [1, 2, 3, 4]
+    pks = [bls.SkToPk(sk) for sk in sks]
+    sigs = [bls.Sign(sk, msg) for sk in sks]
+    agg = bls.Aggregate(sigs)
+    assert bls.FastAggregateVerify(pks, msg, agg)
+    assert not bls.FastAggregateVerify(pks[:3], msg, agg)
+    assert not bls.FastAggregateVerify(pks, b"\x35" * 32, agg)
+    assert not bls.FastAggregateVerify([], msg, agg)
+
+
+def test_aggregate_verify_distinct_messages():
+    sks = [11, 22, 33]
+    msgs = [bytes([i]) * 32 for i in range(3)]
+    pks = [bls.SkToPk(sk) for sk in sks]
+    sigs = [bls.Sign(sk, m) for sk, m in zip(sks, msgs)]
+    agg = bls.Aggregate(sigs)
+    assert bls.AggregateVerify(pks, msgs, agg)
+    assert not bls.AggregateVerify(pks, msgs[::-1], agg)
+    assert not bls.AggregateVerify(pks, msgs[:2], agg)
+
+
+def test_aggregate_empty_raises():
+    with pytest.raises(ValueError):
+        bls.Aggregate([])
+
+
+def test_aggregate_pks_matches_sum():
+    sks = [5, 6]
+    pks = [bls.SkToPk(sk) for sk in sks]
+    agg_pk = bls.AggregatePKs(pks)
+    assert agg_pk == bls.SkToPk(11)
+
+
+def test_key_validate():
+    assert bls.KeyValidate(bls.SkToPk(99))
+    assert not bls.KeyValidate(bytes([0xC0]) + b"\x00" * 47)  # infinity
+    assert not bls.KeyValidate(b"\x00" * 48)
+
+
+def test_signature_to_G2_roundtrip():
+    sig = bls.Sign(7, b"m")
+    coords = bls.signature_to_G2(sig)
+    ((x0, x1), (y0, y1)) = coords
+    aff = (Fq2(x0, x1), Fq2(y0, y1))
+    assert is_on_curve_g2(aff)
+
+
+def test_bls_switch_stubs():
+    bls.bls_active = False
+    try:
+        assert bls.Verify(b"junk", b"m", b"junk") is True
+        assert bls.Sign(123, b"m") == bls.STUB_SIGNATURE
+        assert bls.SkToPk(123) == bls.STUB_PUBKEY
+        assert bls.Aggregate([]) == bls.STUB_SIGNATURE
+    finally:
+        bls.bls_active = True

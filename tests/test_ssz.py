@@ -1,0 +1,222 @@
+"""SSZ engine tests: serialization, merkleization, deserialization roundtrips.
+
+Modeled on the reference's ssz_generic / ssz_static test strategy
+(reference: tests/generators/ssz_generic, SURVEY.md section 4.8).
+"""
+import pytest
+
+from consensus_specs_tpu.utils.hash_function import hash
+from consensus_specs_tpu.utils.ssz.ssz_impl import hash_tree_root, serialize
+from consensus_specs_tpu.utils.ssz.ssz_typing import (
+    Bitlist, Bitvector, ByteList, Bytes32, Bytes48, Container, List, Union,
+    Vector, boolean, uint8, uint16, uint32, uint64, uint256,
+)
+
+
+def test_uint_serialization():
+    assert serialize(uint64(0)) == b"\x00" * 8
+    assert serialize(uint64(0x0123456789ABCDEF)) == bytes.fromhex("efcdab8967452301")
+    assert serialize(uint8(255)) == b"\xff"
+    assert serialize(uint16(0x1234)) == b"\x34\x12"
+    assert uint64.decode_bytes(b"\x01" + b"\x00" * 7) == 1
+
+
+def test_uint_range_checks():
+    with pytest.raises(ValueError):
+        uint8(256)
+    with pytest.raises(ValueError):
+        uint64(-1)
+    with pytest.raises(ValueError):
+        uint64(2**64)
+
+
+def test_uint_checked_arithmetic():
+    a = uint64(2**62)
+    assert a + a - a == a
+    assert type(a + 1) is uint64
+    with pytest.raises(ValueError):
+        _ = uint64(2**63) * 2
+    with pytest.raises(ValueError):
+        _ = uint64(0) - 1
+    assert uint64(7) // 2 == 3
+    assert uint64(7) % 2 == 1
+
+
+def test_uint_hash_tree_root():
+    assert hash_tree_root(uint64(17)) == (17).to_bytes(8, "little") + b"\x00" * 24
+    assert hash_tree_root(uint256(1)) == (1).to_bytes(32, "little")
+    assert hash_tree_root(boolean(True)) == b"\x01" + b"\x00" * 31
+
+
+def test_bytes32_htr_is_identity():
+    v = Bytes32(b"\x42" * 32)
+    assert hash_tree_root(v) == b"\x42" * 32
+    assert serialize(v) == b"\x42" * 32
+
+
+def test_bytes48_htr_pads_second_chunk():
+    v = Bytes48(b"\x01" * 48)
+    chunk0 = b"\x01" * 32
+    chunk1 = b"\x01" * 16 + b"\x00" * 16
+    assert hash_tree_root(v) == hash(chunk0 + chunk1)
+
+
+def test_vector_of_uint64():
+    v = Vector[uint64, 4](1, 2, 3, 4)
+    expected_ser = b"".join(i.to_bytes(8, "little") for i in (1, 2, 3, 4))
+    assert serialize(v) == expected_ser
+    assert hash_tree_root(v) == expected_ser  # 32 bytes exactly = single chunk
+    assert Vector[uint64, 4].decode_bytes(expected_ser) == v
+
+
+def test_vector_wrong_length_rejected():
+    with pytest.raises(ValueError):
+        Vector[uint64, 4](1, 2, 3)
+
+
+def test_list_mix_in_length():
+    l = List[uint64, 1024](1, 2)
+    chunks_root_input = serialize(l).ljust(32, b"\x00")
+    # limit 1024 uint64 = 256 chunks -> depth 8 over zero-padded tree
+    from consensus_specs_tpu.utils.ssz.ssz_typing import merkleize_chunks
+
+    root = merkleize_chunks([chunks_root_input], limit=256)
+    assert hash_tree_root(l) == hash(root + (2).to_bytes(32, "little"))
+    assert List[uint64, 1024].decode_bytes(serialize(l)) == l
+
+
+def test_list_limit_enforced():
+    l = List[uint64, 2](1, 2)
+    with pytest.raises(ValueError):
+        l.append(3)
+    with pytest.raises(ValueError):
+        List[uint64, 2](1, 2, 3)
+
+
+def test_empty_list_htr():
+    from consensus_specs_tpu.utils.ssz.ssz_typing import ZERO_HASHES
+
+    l = List[uint64, 1024]()
+    assert hash_tree_root(l) == hash(ZERO_HASHES[8] + b"\x00" * 32)
+
+
+def test_bitvector():
+    bv = Bitvector[10](1, 0, 1, 0, 0, 0, 0, 0, 1, 1)
+    assert serialize(bv) == bytes([0b00000101, 0b00000011])
+    assert Bitvector[10].decode_bytes(serialize(bv)) == bv
+    with pytest.raises(ValueError):
+        Bitvector[10].decode_bytes(bytes([0xFF, 0xFF]))  # nonzero padding
+
+
+def test_bitlist():
+    bl = Bitlist[16](1, 0, 1)
+    # bits 101 + delimiter at position 3 -> 0b1101
+    assert serialize(bl) == bytes([0b1101])
+    assert Bitlist[16].decode_bytes(serialize(bl)) == bl
+    assert len(bl) == 3
+    empty = Bitlist[16]()
+    assert serialize(empty) == bytes([1])
+    assert Bitlist[16].decode_bytes(bytes([1])) == empty
+    with pytest.raises(ValueError):
+        Bitlist[16].decode_bytes(b"")
+    with pytest.raises(ValueError):
+        Bitlist[16].decode_bytes(bytes([0b101, 0]))  # missing delimiter
+    with pytest.raises(ValueError):
+        Bitlist[2].decode_bytes(bytes([0b1101]))  # 3 bits > limit 2
+
+
+class FixedC(Container):
+    a: uint64
+    b: Bytes32
+
+
+class VarC(Container):
+    a: uint64
+    items: List[uint8, 32]
+    b: uint16
+
+
+def test_container_fixed_serialization():
+    c = FixedC(a=uint64(5), b=Bytes32(b"\x09" * 32))
+    assert serialize(c) == (5).to_bytes(8, "little") + b"\x09" * 32
+    assert FixedC.decode_bytes(serialize(c)) == c
+    assert hash_tree_root(c) == hash(
+        ((5).to_bytes(8, "little") + b"\x00" * 24) + b"\x09" * 32
+    )
+
+
+def test_container_variable_serialization():
+    c = VarC(a=uint64(1), items=List[uint8, 32](7, 8, 9), b=uint16(2))
+    ser = serialize(c)
+    # fixed part: 8 bytes a + 4 byte offset + 2 bytes b = 14; offset = 14
+    assert ser == (1).to_bytes(8, "little") + (14).to_bytes(4, "little") + (2).to_bytes(
+        2, "little"
+    ) + bytes([7, 8, 9])
+    assert VarC.decode_bytes(ser) == c
+
+
+def test_container_defaults_and_mutation():
+    c = VarC()
+    assert c.a == 0 and len(c.items) == 0
+    c.a = 42
+    assert c.a == uint64(42)
+    c.items.append(uint8(1))
+    assert len(c.items) == 1
+    with pytest.raises(AttributeError):
+        c.nonexistent = 1
+
+
+def test_container_snapshot_on_store_alias_on_read():
+    inner = FixedC(a=uint64(1))
+
+    class Outer(Container):
+        x: FixedC
+
+    o = Outer(x=inner)
+    inner.a = uint64(99)
+    assert o.x.a == 1  # stored a snapshot
+    o.x.a = uint64(5)
+    assert o.x.a == 5  # reads alias
+
+
+def test_container_copy_is_deep():
+    c = VarC(a=uint64(1), items=List[uint8, 32](1))
+    c2 = c.copy()
+    c2.items.append(uint8(2))
+    c2.a = uint64(9)
+    assert len(c.items) == 1 and c.a == 1
+
+
+def test_union():
+    U = Union[None, uint16, uint32]
+    u = U(1, uint16(0xAABB))
+    assert serialize(u) == bytes([1, 0xBB, 0xAA])
+    assert U.decode_bytes(serialize(u)) == u
+    n = U(0)
+    assert serialize(n) == bytes([0])
+    assert hash_tree_root(u) == hash(
+        (uint16(0xAABB).encode_bytes().ljust(32, b"\x00")) + (1).to_bytes(32, "little")
+    )
+
+
+def test_bytelist():
+    bl = ByteList[64](b"abc")
+    assert serialize(bl) == b"abc"
+    assert ByteList[64].decode_bytes(b"abc") == bl
+    with pytest.raises(ValueError):
+        ByteList[2](b"abc")
+
+
+def test_nested_variable_lists():
+    T = List[List[uint8, 4], 4]
+    v = T([List[uint8, 4](1, 2), List[uint8, 4](), List[uint8, 4](3)])
+    ser = serialize(v)
+    assert T.decode_bytes(ser) == v
+
+
+def test_vector_of_containers_htr():
+    T = Vector[FixedC, 2]
+    v = T([FixedC(a=uint64(1)), FixedC(a=uint64(2))])
+    assert hash_tree_root(v) == hash(
+        v[0].hash_tree_root() + v[1].hash_tree_root()
+    )
