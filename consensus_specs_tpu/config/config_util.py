@@ -1,0 +1,77 @@
+"""Preset/config YAML loaders.
+
+(reference: tests/core/pyspec/eth2spec/config/config_util.py:6-63 and the
+compile-time loaders in setup.py:763-787)
+"""
+import os
+from pathlib import Path
+from typing import Any, Dict, Sequence
+
+import yaml
+
+# repo root holds configs/ and presets/ (same layout as the reference)
+_REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+PRESETS_DIR = _REPO_ROOT / "presets"
+CONFIGS_DIR = _REPO_ROOT / "configs"
+
+
+def parse_config_vars(conf: Dict[str, Any]) -> Dict[str, Any]:
+    """Parse YAML values into python types: 0x-prefixed strings stay as hex
+    bytes markers, decimal strings become ints
+    (reference: config/config_util.py:6-21)."""
+    out: Dict[str, Any] = {}
+    for k, v in conf.items():
+        if isinstance(v, str) and v.startswith("0x"):
+            out[k] = bytes.fromhex(v[2:])
+        elif k == "PRESET_BASE":
+            out[k] = str(v)
+        elif isinstance(v, str) and v.isdigit():
+            out[k] = int(v)
+        else:
+            out[k] = v
+    return out
+
+
+def load_preset(preset_files: Sequence[os.PathLike]) -> Dict[str, Any]:
+    """Merge per-fork preset files with duplicate-key checking
+    (reference: config/config_util.py:24-39)."""
+    preset: Dict[str, Any] = {}
+    for fname in preset_files:
+        with open(fname) as f:
+            data = yaml.load(f, Loader=yaml.BaseLoader)
+        for k in data:
+            if k in preset:
+                raise KeyError(f"duplicate preset var {k!r} in {fname}")
+        preset.update(data)
+    return parse_config_vars(preset)
+
+
+def load_config_file(path: os.PathLike) -> Dict[str, Any]:
+    """(reference: config/config_util.py:42-48)"""
+    with open(path) as f:
+        config_data = yaml.load(f, Loader=yaml.BaseLoader)
+    return parse_config_vars(config_data)
+
+
+_defaults_cache: Dict[str, Dict[str, Any]] = {}
+
+# fork lineage: preset files are merged in this order up to the built fork
+# (reference: setup.py per-fork md-doc lists, :843-872)
+PRESET_FORK_FILES = ["phase0", "altair", "merge", "custody_game", "sharding"]
+
+
+def load_preset_for_fork(preset_name: str, fork: str) -> Dict[str, Any]:
+    idx = PRESET_FORK_FILES.index(fork) if fork in PRESET_FORK_FILES else len(PRESET_FORK_FILES)
+    files = []
+    for name in PRESET_FORK_FILES[: idx + 1]:
+        path = PRESETS_DIR / preset_name / f"{name}.yaml"
+        if path.exists():
+            files.append(path)
+    return load_preset(files)
+
+
+def load_defaults(preset_name: str) -> Dict[str, Any]:
+    """Cached full config for a preset (reference: config/config_util.py:56-63)."""
+    if preset_name not in _defaults_cache:
+        _defaults_cache[preset_name] = load_config_file(CONFIGS_DIR / f"{preset_name}.yaml")
+    return _defaults_cache[preset_name]

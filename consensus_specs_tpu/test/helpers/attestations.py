@@ -1,0 +1,220 @@
+"""Attestation-building helpers (reference: test/helpers/attestations.py)."""
+from .block import build_empty_block_for_next_slot
+from .keys import privkeys
+from .state import next_slot, state_transition_and_sign_block, transition_to
+
+
+def run_attestation_processing(spec, state, attestation, valid=True):
+    """Run ``process_attestation``, yielding (pre, attestation, post) parts;
+    if ``valid == False``, run expecting ``AssertionError``."""
+    from ..context import expect_assertion_error
+
+    # yield pre-state
+    yield 'pre', state
+
+    yield 'attestation', attestation
+
+    # If the attestation is invalid, processing is aborted, and there is no post-state.
+    if not valid:
+        expect_assertion_error(lambda: spec.process_attestation(state, attestation))
+        yield 'post', None
+        return
+
+    current_epoch_count = len(state.current_epoch_attestations)
+    previous_epoch_count = len(state.previous_epoch_attestations)
+
+    # process attestation
+    spec.process_attestation(state, attestation)
+
+    # Make sure the attestation has been processed
+    if attestation.data.target.epoch == spec.get_current_epoch(state):
+        assert len(state.current_epoch_attestations) == current_epoch_count + 1
+    else:
+        assert len(state.previous_epoch_attestations) == previous_epoch_count + 1
+
+    # yield post-state
+    yield 'post', state
+
+
+def build_attestation_data(spec, state, slot, index, beacon_block_root=None):
+    assert state.slot >= slot
+
+    if beacon_block_root is not None:
+        block_root = beacon_block_root
+    elif slot == state.slot:
+        block_root = build_empty_block_for_next_slot(spec, state).parent_root
+    else:
+        block_root = spec.get_block_root_at_slot(state, slot)
+
+    current_epoch_start_slot = spec.compute_start_slot_at_epoch(spec.get_current_epoch(state))
+    if slot < current_epoch_start_slot:
+        epoch_boundary_root = spec.get_block_root(state, spec.get_previous_epoch(state))
+    elif slot == current_epoch_start_slot:
+        epoch_boundary_root = block_root
+    else:
+        epoch_boundary_root = spec.get_block_root(state, spec.get_current_epoch(state))
+
+    if slot < current_epoch_start_slot:
+        source_epoch = state.previous_justified_checkpoint.epoch
+        source_root = state.previous_justified_checkpoint.root
+    else:
+        source_epoch = state.current_justified_checkpoint.epoch
+        source_root = state.current_justified_checkpoint.root
+
+    return spec.AttestationData(
+        slot=slot,
+        index=index,
+        beacon_block_root=block_root,
+        source=spec.Checkpoint(epoch=source_epoch, root=source_root),
+        target=spec.Checkpoint(epoch=spec.compute_epoch_at_slot(slot), root=epoch_boundary_root),
+    )
+
+
+def get_valid_attestation(spec, state, slot=None, index=None,
+                          filter_participant_set=None, beacon_block_root=None, signed=False):
+    """Construct a valid attestation for ``slot`` and committee ``index``.
+
+    If ``filter_participant_set`` filters the full committee to an empty set,
+    the attestation has 0 participants and a zeroed signature.
+    """
+    # If filter_participant_set filters everything, the attestation has 0 participants, and cannot be signed.
+    # Thus strictly speaking invalid when no participant is added later.
+    if slot is None:
+        slot = state.slot
+    if index is None:
+        index = 0
+
+    attestation_data = build_attestation_data(
+        spec, state, slot=slot, index=index, beacon_block_root=beacon_block_root
+    )
+
+    beacon_committee = spec.get_beacon_committee(state, attestation_data.slot, attestation_data.index)
+
+    committee_size = len(beacon_committee)
+    aggregation_bits = spec.Bitlist[spec.MAX_VALIDATORS_PER_COMMITTEE]([0] * committee_size)
+    attestation = spec.Attestation(
+        aggregation_bits=aggregation_bits,
+        data=attestation_data,
+    )
+    # fill the attestation with (optionally filtered) participants, and optionally sign it
+    fill_aggregate_attestation(spec, state, attestation, signed=signed,
+                               filter_participant_set=filter_participant_set)
+
+    return attestation
+
+
+def sign_aggregate_attestation(spec, state, attestation_data, participants):
+    signatures = []
+    for validator_index in participants:
+        privkey = privkeys[validator_index]
+        signatures.append(get_attestation_signature(spec, state, attestation_data, privkey))
+    return spec.bls.Aggregate(signatures)
+
+
+def sign_indexed_attestation(spec, state, indexed_attestation):
+    participants = indexed_attestation.attesting_indices
+    data = indexed_attestation.data
+    indexed_attestation.signature = sign_aggregate_attestation(spec, state, data, participants)
+
+
+def sign_attestation(spec, state, attestation):
+    participants = spec.get_attesting_indices(
+        state,
+        attestation.data,
+        attestation.aggregation_bits,
+    )
+    attestation.signature = sign_aggregate_attestation(spec, state, attestation.data, participants)
+
+
+def get_attestation_signature(spec, state, attestation_data, privkey):
+    domain = spec.get_domain(state, spec.DOMAIN_BEACON_ATTESTER, attestation_data.target.epoch)
+    signing_root = spec.compute_signing_root(attestation_data, domain)
+    return spec.bls.Sign(privkey, signing_root)
+
+
+def fill_aggregate_attestation(spec, state, attestation, signed=False, filter_participant_set=None):
+    """`signed`: whether to sign the attestation.
+    `filter_participant_set`: filters the full committee to a subset."""
+    beacon_committee = spec.get_beacon_committee(
+        state,
+        attestation.data.slot,
+        attestation.data.index,
+    )
+    # By default, have everyone participate
+    participants = set(beacon_committee)
+    # But optionally filter the participants to a smaller amount
+    if filter_participant_set is not None:
+        participants = filter_participant_set(participants)
+    for i in range(len(beacon_committee)):
+        attestation.aggregation_bits[i] = beacon_committee[i] in participants
+    if signed and len(participants) > 0:
+        sign_attestation(spec, state, attestation)
+
+
+def add_attestations_to_state(spec, state, attestations, slot):
+    transition_to(spec, state, slot)
+    for attestation in attestations:
+        spec.process_attestation(state, attestation)
+
+
+def _get_valid_attestation_at_slot(state, spec, slot_to_attest, participation_fn=None):
+    committees_per_slot = spec.get_committee_count_per_slot(
+        state, spec.compute_epoch_at_slot(slot_to_attest)
+    )
+    for index in range(committees_per_slot):
+        def participants_filter(comm):
+            if participation_fn is None:
+                return comm
+            return participation_fn(state.slot, index, comm)
+
+        yield get_valid_attestation(
+            spec,
+            state,
+            slot_to_attest,
+            index=index,
+            signed=True,
+            filter_participant_set=participants_filter,
+        )
+
+
+def state_transition_with_full_block(spec, state, fill_cur_epoch, fill_prev_epoch,
+                                     participation_fn=None):
+    """Build and apply a block with attestations at the calculated `slot_to_attest` of
+    current epoch and/or previous epoch."""
+    block = build_empty_block_for_next_slot(spec, state)
+    if fill_cur_epoch and state.slot >= spec.MIN_ATTESTATION_INCLUSION_DELAY:
+        slot_to_attest = state.slot - spec.MIN_ATTESTATION_INCLUSION_DELAY + 1
+        if slot_to_attest >= spec.compute_start_slot_at_epoch(spec.get_current_epoch(state)):
+            attestations = _get_valid_attestation_at_slot(state, spec, slot_to_attest, participation_fn)
+            for attestation in attestations:
+                block.body.attestations.append(attestation)
+    if fill_prev_epoch:
+        slot_to_attest = state.slot - spec.SLOTS_PER_EPOCH + 1
+        attestations = _get_valid_attestation_at_slot(state, spec, slot_to_attest, participation_fn)
+        for attestation in attestations:
+            block.body.attestations.append(attestation)
+
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    return signed_block
+
+
+def next_slots_with_attestations(spec, state, slot_count, fill_cur_epoch, fill_prev_epoch,
+                                 participation_fn=None):
+    post_state = state.copy()
+    signed_blocks = []
+    for _ in range(slot_count):
+        signed_block = state_transition_with_full_block(
+            spec, post_state, fill_cur_epoch, fill_prev_epoch, participation_fn
+        )
+        signed_blocks.append(signed_block)
+
+    return state, signed_blocks, post_state
+
+
+def next_epoch_with_attestations(spec, state, fill_cur_epoch, fill_prev_epoch,
+                                 participation_fn=None):
+    assert state.slot % spec.SLOTS_PER_EPOCH == 0
+
+    return next_slots_with_attestations(
+        spec, state, spec.SLOTS_PER_EPOCH, fill_cur_epoch, fill_prev_epoch, participation_fn
+    )

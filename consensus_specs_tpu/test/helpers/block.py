@@ -1,0 +1,106 @@
+"""Block-building helpers (reference: test/helpers/block.py)."""
+from .keys import privkeys
+
+
+def get_proposer_index_maybe(spec, state, slot, proposer_index=None):
+    if proposer_index is None:
+        assert state.slot <= slot
+        if slot == state.slot:
+            proposer_index = spec.get_beacon_proposer_index(state)
+        else:
+            if spec.compute_epoch_at_slot(state.slot) + 1 > spec.compute_epoch_at_slot(slot):
+                print("warning: block slot far away, and no proposer index manually given."
+                      " Signing block is slow due to transition for proposer index calculation.")
+            # use a copy of the state to compute the proposer index
+            stub_state = state.copy()
+            if stub_state.slot < slot:
+                spec.process_slots(stub_state, slot)
+            proposer_index = spec.get_beacon_proposer_index(stub_state)
+    return proposer_index
+
+
+def apply_randao_reveal(spec, state, block, proposer_index=None):
+    assert state.slot <= block.slot
+    proposer_index = get_proposer_index_maybe(spec, state, block.slot, proposer_index)
+    privkey = privkeys[proposer_index]
+
+    domain = spec.get_domain(state, spec.DOMAIN_RANDAO, spec.compute_epoch_at_slot(block.slot))
+    signing_root = spec.compute_signing_root(spec.compute_epoch_at_slot(block.slot), domain)
+    block.body.randao_reveal = spec.bls.Sign(privkey, signing_root)
+
+
+def sign_block(spec, state, block, proposer_index=None):
+    proposer_index = get_proposer_index_maybe(spec, state, block.slot, proposer_index)
+    privkey = privkeys[proposer_index]
+
+    domain = spec.get_domain(state, spec.DOMAIN_BEACON_PROPOSER, spec.compute_epoch_at_slot(block.slot))
+    signing_root = spec.compute_signing_root(block, domain)
+    signature = spec.bls.Sign(privkey, signing_root)
+    return spec.SignedBeaconBlock(message=block, signature=signature)
+
+
+def transition_unsigned_block(spec, state, block):
+    if state.slot < block.slot:
+        spec.process_slots(state, block.slot)
+    assert state.latest_block_header.slot < block.slot  # There may not already be a block in this slot or past it.
+    assert state.slot == block.slot  # The block must be for this slot
+    spec.process_block(state, block)
+    return block
+
+
+def build_empty_block(spec, state, slot=None):
+    """Build an empty block for ``slot``, deriving parent root, proposer, and
+    randao reveal from (a copy of) the state."""
+    if slot is None:
+        slot = state.slot
+    if slot < state.slot:
+        raise Exception("build_empty_block cannot build blocks for past slots")
+    if state.slot < slot:
+        # transition forward in copied state to grab relevant data from state
+        state = state.copy()
+        spec.process_slots(state, slot)
+
+    state, parent_block_root = get_state_and_beacon_parent_root_at_slot(spec, state, slot)
+    empty_block = spec.BeaconBlock()
+    empty_block.slot = slot
+    empty_block.proposer_index = spec.get_beacon_proposer_index(state)
+    empty_block.body.eth1_data.deposit_count = state.eth1_deposit_index
+    empty_block.parent_root = parent_block_root
+
+    apply_randao_reveal(spec, state, empty_block)
+    return empty_block
+
+
+def build_empty_block_for_next_slot(spec, state):
+    return build_empty_block(spec, state, state.slot + 1)
+
+
+def get_state_and_beacon_parent_root_at_slot(spec, state, slot):
+    if slot < state.slot:
+        raise Exception("Cannot build blocks for past slots")
+    if slot > state.slot:
+        # transition forward in copied state to grab relevant data from state
+        state = state.copy()
+        spec.process_slots(state, slot)
+
+    previous_block_header = state.latest_block_header.copy()
+    if previous_block_header.state_root == spec.Root():
+        previous_block_header.state_root = spec.hash_tree_root(state)
+    beacon_parent_root = spec.hash_tree_root(previous_block_header)
+    return state, beacon_parent_root
+
+
+def apply_empty_block(spec, state, slot=None):
+    """Transition via an empty block (on current slot, assuming no block has
+    been applied yet)."""
+    from .state import state_transition_and_sign_block
+
+    block = build_empty_block(spec, state, slot)
+    return state_transition_and_sign_block(spec, state, block)
+
+
+def sign_block_header(spec, state, header, privkey):
+    domain = spec.get_domain(state, spec.DOMAIN_BEACON_PROPOSER, spec.compute_epoch_at_slot(header.slot))
+    signing_root = spec.compute_signing_root(header, domain)
+    signature = spec.bls.Sign(privkey, signing_root)
+    return spec.SignedBeaconBlockHeader(message=header, signature=signature)

@@ -1,0 +1,53 @@
+"""Epoch-processing sub-pass runners (reference: test/helpers/epoch_processing.py)."""
+
+
+def get_process_calls(spec):
+    # ordered epoch-processing sub-passes per fork
+    # (reference specs/phase0/beacon-chain.md:1286-1298; altair:567-583)
+    return [
+        'process_justification_and_finalization',
+        'process_inactivity_updates',  # altair
+        'process_rewards_and_penalties',
+        'process_registry_updates',
+        'process_slashings',
+        'process_eth1_data_reset',
+        'process_effective_balance_updates',
+        'process_slashings_reset',
+        'process_randao_mixes_reset',
+        'process_historical_roots_update',
+        # phase0 only:
+        'process_participation_record_updates',
+        # altair replacement:
+        'process_participation_flag_updates',
+        'process_sync_committee_updates',
+    ]
+
+
+def run_epoch_processing_to(spec, state, process_name):
+    """Processes to the next epoch transition, up to (but not including) the
+    sub-transition named ``process_name``."""
+    slot = state.slot + (spec.SLOTS_PER_EPOCH - state.slot % spec.SLOTS_PER_EPOCH)
+
+    # transition state to slot before epoch state transition
+    if state.slot < slot - 1:
+        spec.process_slots(state, slot - 1)
+
+    # start transitioning, do one slot update before the epoch itself.
+    spec.process_slot(state)
+
+    # process components of epoch transition before final-updates
+    for name in get_process_calls(spec):
+        if name == process_name:
+            break
+        # only run when present. Later phases introduce more to the epoch-processing.
+        if hasattr(spec, name):
+            getattr(spec, name)(state)
+
+
+def run_epoch_processing_with(spec, state, process_name):
+    """Processes to the next epoch transition, up to the sub-transition named
+    ``process_name``, yielding (pre, post) test-vector parts."""
+    run_epoch_processing_to(spec, state, process_name)
+    yield 'pre', state
+    getattr(spec, process_name)(state)
+    yield 'post', state

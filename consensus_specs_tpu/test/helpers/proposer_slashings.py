@@ -1,0 +1,88 @@
+"""Proposer-slashing helpers (reference: test/helpers/proposer_slashings.py)."""
+from .block import sign_block_header
+from .keys import privkeys
+
+
+def get_min_slashing_penalty_quotient(spec):
+    if spec.fork == "merge":
+        return spec.MIN_SLASHING_PENALTY_QUOTIENT_MERGE
+    if spec.fork == "altair":
+        return spec.MIN_SLASHING_PENALTY_QUOTIENT_ALTAIR
+    return spec.MIN_SLASHING_PENALTY_QUOTIENT
+
+
+def check_proposer_slashing_effect(spec, pre_state, state, slashed_index):
+    slashed_validator = state.validators[slashed_index]
+    assert slashed_validator.slashed
+    assert slashed_validator.exit_epoch < spec.FAR_FUTURE_EPOCH
+    assert slashed_validator.withdrawable_epoch < spec.FAR_FUTURE_EPOCH
+
+    proposer_index = spec.get_beacon_proposer_index(state)
+    slash_penalty = state.validators[slashed_index].effective_balance // get_min_slashing_penalty_quotient(spec)
+    whistleblower_reward = state.validators[slashed_index].effective_balance // spec.WHISTLEBLOWER_REWARD_QUOTIENT
+    if proposer_index != slashed_index:
+        # slashed validator lost initial slash penalty
+        assert state.balances[slashed_index] == pre_state.balances[slashed_index] - slash_penalty
+        # block proposer gained whistleblower reward
+        assert state.balances[proposer_index] == pre_state.balances[proposer_index] + whistleblower_reward
+    else:
+        # proposer slashed themself: penalty and reward applied to the same balance
+        assert state.balances[slashed_index] == (
+            pre_state.balances[slashed_index] - slash_penalty + whistleblower_reward
+        )
+
+
+def get_valid_proposer_slashing(spec, state, random_root=b'\x99' * 32,
+                                slashed_index=None, slot=None, signed_1=False, signed_2=False):
+    if slashed_index is None:
+        current_epoch = spec.get_current_epoch(state)
+        slashed_index = spec.get_active_validator_indices(state, current_epoch)[-1]
+    privkey = privkeys[slashed_index]
+    if slot is None:
+        slot = state.slot
+
+    header_1 = spec.BeaconBlockHeader(
+        slot=slot,
+        proposer_index=slashed_index,
+        parent_root=b'\x33' * 32,
+        state_root=b'\x44' * 32,
+        body_root=b'\x55' * 32,
+    )
+    header_2 = header_1.copy()
+    header_2.parent_root = random_root
+
+    if signed_1:
+        signed_header_1 = sign_block_header(spec, state, header_1, privkey)
+    else:
+        signed_header_1 = spec.SignedBeaconBlockHeader(message=header_1)
+    if signed_2:
+        signed_header_2 = sign_block_header(spec, state, header_2, privkey)
+    else:
+        signed_header_2 = spec.SignedBeaconBlockHeader(message=header_2)
+
+    return spec.ProposerSlashing(
+        signed_header_1=signed_header_1,
+        signed_header_2=signed_header_2,
+    )
+
+
+def run_proposer_slashing_processing(spec, state, proposer_slashing, valid=True):
+    """Run ``process_proposer_slashing``, yielding (pre, op, post) parts;
+    if ``valid == False``, run expecting ``AssertionError``."""
+    from ..context import expect_assertion_error
+
+    pre_state = state.copy()
+
+    yield 'pre', state
+    yield 'proposer_slashing', proposer_slashing
+
+    if not valid:
+        expect_assertion_error(lambda: spec.process_proposer_slashing(state, proposer_slashing))
+        yield 'post', None
+        return
+
+    spec.process_proposer_slashing(state, proposer_slashing)
+    yield 'post', state
+
+    slashed_index = proposer_slashing.signed_header_1.message.proposer_index
+    check_proposer_slashing_effect(spec, pre_state, state, slashed_index)

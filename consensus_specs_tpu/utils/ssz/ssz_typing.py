@@ -159,56 +159,89 @@ class uint(int, View):
     def hash_tree_root(self) -> bytes:
         return self.encode_bytes().ljust(32, b"\x00")
 
-    # checked arithmetic: result stays in-type, raises on out-of-range
+    # checked arithmetic: result stays in-type, raises on out-of-range;
+    # non-int operands defer (NotImplemented) so e.g. list * uint64 repeats
     def _wrap(self, v: int) -> "uint":
         return type(self)(v)
 
     def __add__(self, o):
+        if not isinstance(o, int):
+            return NotImplemented
         return self._wrap(int(self) + int(o))
 
     def __radd__(self, o):
+        if not isinstance(o, int):
+            return NotImplemented
         return self._wrap(int(o) + int(self))
 
     def __sub__(self, o):
+        if not isinstance(o, int):
+            return NotImplemented
         return self._wrap(int(self) - int(o))
 
     def __rsub__(self, o):
+        if not isinstance(o, int):
+            return NotImplemented
         return self._wrap(int(o) - int(self))
 
     def __mul__(self, o):
+        if not isinstance(o, int):
+            return NotImplemented
         return self._wrap(int(self) * int(o))
 
     def __rmul__(self, o):
+        if not isinstance(o, int):
+            return NotImplemented
         return self._wrap(int(o) * int(self))
 
     def __floordiv__(self, o):
+        if not isinstance(o, int):
+            return NotImplemented
         return self._wrap(int(self) // int(o))
 
     def __rfloordiv__(self, o):
+        if not isinstance(o, int):
+            return NotImplemented
         return self._wrap(int(o) // int(self))
 
     def __mod__(self, o):
+        if not isinstance(o, int):
+            return NotImplemented
         return self._wrap(int(self) % int(o))
 
     def __rmod__(self, o):
+        if not isinstance(o, int):
+            return NotImplemented
         return self._wrap(int(o) % int(self))
 
     def __pow__(self, o, mod=None):
+        if not isinstance(o, int):
+            return NotImplemented
         return self._wrap(pow(int(self), int(o), mod))
 
     def __lshift__(self, o):
+        if not isinstance(o, int):
+            return NotImplemented
         return self._wrap(int(self) << int(o))
 
     def __rshift__(self, o):
+        if not isinstance(o, int):
+            return NotImplemented
         return self._wrap(int(self) >> int(o))
 
     def __and__(self, o):
+        if not isinstance(o, int):
+            return NotImplemented
         return self._wrap(int(self) & int(o))
 
     def __or__(self, o):
+        if not isinstance(o, int):
+            return NotImplemented
         return self._wrap(int(self) | int(o))
 
     def __xor__(self, o):
+        if not isinstance(o, int):
+            return NotImplemented
         return self._wrap(int(self) ^ int(o))
 
     def __neg__(self):
@@ -444,7 +477,14 @@ class Bitvector(View):
         return self._bits[i]
 
     def __setitem__(self, i, v):
-        self._bits[i] = bool(v)
+        if isinstance(i, slice):
+            new_bits = list(self._bits)
+            new_bits[i] = [bool(b) for b in v]
+            if len(new_bits) != self.LENGTH:
+                raise ValueError(f"{type(self).__name__}: slice assignment changes length")
+            self._bits = new_bits
+        else:
+            self._bits[i] = bool(v)
 
     def __iter__(self):
         return iter(self._bits)
