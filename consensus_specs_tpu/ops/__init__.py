@@ -1,0 +1,12 @@
+"""TPU compute plane: JAX/XLA kernels for BLS12-381.
+
+This package is the TPU-native replacement for the reference's native BLS
+backend (`milagro_bls_binding`, C — reference utils/bls.py:17-22): batched
+pairing-based signature verification lowered to XLA, designed so the batch
+dimension maps onto TPU vector units and `shard_map` device meshes.
+
+x64 mode is required: limb arithmetic uses uint64 accumulators.
+"""
+import jax
+
+jax.config.update("jax_enable_x64", True)

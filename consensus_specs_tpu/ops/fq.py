@@ -1,0 +1,187 @@
+"""Base-field (Fq) limb arithmetic for BLS12-381 in JAX.
+
+Representation: an Fq element is an array of shape (..., 14) of uint64 limbs,
+29 bits per limb (14*29 = 406 bits), in Montgomery form with R = 2^406.
+All operations are batched over leading dims — parallelism lives in the batch
+dimensions, keeping the XLA graph size independent of batch size.
+
+Montgomery multiply is CIOS with delayed carries: products are < 2^58, each
+accumulator column absorbs at most ~28 products before being shifted out, so
+uint64 never overflows (28 * 2^58 < 2^63).
+
+Cross-checked bit-exactly against the pure-Python oracle
+(consensus_specs_tpu.utils.bls12_381) in tests/test_ops_fq.py.
+"""
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.bls12_381 import P
+
+LIMB_BITS = 29
+NUM_LIMBS = 14
+MASK = (1 << LIMB_BITS) - 1
+R_BITS = LIMB_BITS * NUM_LIMBS  # 406
+R_MONT = 1 << R_BITS
+
+
+def _int_to_limbs_np(x: int) -> np.ndarray:
+    out = np.zeros(NUM_LIMBS, dtype=np.uint64)
+    for i in range(NUM_LIMBS):
+        out[i] = x & MASK
+        x >>= LIMB_BITS
+    assert x == 0
+    return out
+
+
+def limbs_to_int(limbs) -> int:
+    limbs = np.asarray(limbs)
+    x = 0
+    for i in reversed(range(limbs.shape[-1])):
+        x = (x << LIMB_BITS) | int(limbs[..., i])
+    return x
+
+
+P_LIMBS = _int_to_limbs_np(P)
+N0 = (-pow(P, -1, 1 << LIMB_BITS)) % (1 << LIMB_BITS)  # -p^-1 mod 2^29
+R_MOD_P = R_MONT % P
+R2_MOD_P = (R_MONT * R_MONT) % P
+ONE_MONT = _int_to_limbs_np(R_MOD_P)  # 1 in Montgomery form
+ZERO = np.zeros(NUM_LIMBS, dtype=np.uint64)
+
+
+def to_mont_int(x: int) -> np.ndarray:
+    """Host: encode an integer < p into Montgomery-form limbs."""
+    return _int_to_limbs_np((x * R_MONT) % P)
+
+
+def from_mont_limbs(limbs) -> int:
+    """Host: decode Montgomery-form limbs back to an integer < p."""
+    x = limbs_to_int(limbs)
+    return (x * pow(R_MONT, -1, P)) % P
+
+
+_P_LIMBS_J = jnp.asarray(P_LIMBS, dtype=jnp.uint64)
+
+
+def mont_mul(a, b):
+    """Montgomery product a*b*R^-1 mod p; inputs/outputs canonical (< p),
+    limbs < 2^29. Shapes broadcast over leading dims."""
+    a = jnp.asarray(a, jnp.uint64)
+    b = jnp.asarray(b, jnp.uint64)
+    shape = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
+    t = jnp.zeros(shape + (NUM_LIMBS + 1,), dtype=jnp.uint64)
+    n0 = jnp.uint64(N0)
+    mask = jnp.uint64(MASK)
+    for i in range(NUM_LIMBS):
+        ai = a[..., i : i + 1]
+        t = t.at[..., :NUM_LIMBS].add(ai * b)
+        m = ((t[..., 0] & mask) * n0) & mask
+        t = t.at[..., :NUM_LIMBS].add(m[..., None] * _P_LIMBS_J)
+        # t[...,0] is divisible by 2^29; shift one limb down, carrying the
+        # high bits of t[...,0] into the new lowest limb
+        carry = t[..., 0] >> jnp.uint64(LIMB_BITS)
+        t = jnp.concatenate(
+            [t[..., 1:], jnp.zeros(shape + (1,), dtype=jnp.uint64)], axis=-1
+        )
+        t = t.at[..., 0].add(carry)
+    return _canonicalize(t)
+
+
+def _carry_limbs(t):
+    """Propagate carries so limbs < 2^29 (keeps total value)."""
+    n = t.shape[-1]
+    outs = []
+    c = jnp.zeros(t.shape[:-1], dtype=jnp.uint64)
+    for k in range(n):
+        cur = t[..., k] + c
+        outs.append(cur & jnp.uint64(MASK))
+        c = cur >> jnp.uint64(LIMB_BITS)
+    return jnp.stack(outs, axis=-1), c
+
+
+def _geq_p(a):
+    """a >= p for 14-limb canonical-limbed a (lexicographic from the top)."""
+    ge = jnp.ones(a.shape[:-1], dtype=bool)
+    gt = jnp.zeros(a.shape[:-1], dtype=bool)
+    for k in reversed(range(NUM_LIMBS)):
+        pk = jnp.uint64(int(P_LIMBS[k]))
+        gt = gt | (ge & (a[..., k] > pk))
+        ge = ge & (a[..., k] == pk)
+    return gt | ge
+
+
+def _sub_p(a):
+    """a - p with borrow chain (assumes a >= p), limbs stay < 2^29."""
+    outs = []
+    borrow = jnp.zeros(a.shape[:-1], dtype=jnp.uint64)
+    two29 = jnp.uint64(1 << LIMB_BITS)
+    for k in range(NUM_LIMBS):
+        pk = jnp.uint64(int(P_LIMBS[k]))
+        cur = a[..., k] + two29 - pk - borrow
+        outs.append(cur & jnp.uint64(MASK))
+        borrow = jnp.uint64(1) - (cur >> jnp.uint64(LIMB_BITS))
+    return jnp.stack(outs, axis=-1)
+
+
+def _canonicalize(t):
+    """Carry-propagate a (...,15) accumulator and reduce into [0, p)."""
+    limbs, c = _carry_limbs(t)
+    # Montgomery output < 2p for canonical inputs; extra top limb/carry is 0
+    a = limbs[..., :NUM_LIMBS]
+    extra = limbs[..., NUM_LIMBS:].sum(axis=-1) + c if limbs.shape[-1] > NUM_LIMBS else c
+    # fold any stray top bit back (should not occur for canonical inputs)
+    a = jnp.where(_geq_p(a)[..., None], _sub_p(a), a)
+    del extra
+    return a
+
+
+def add(a, b):
+    t = a + b
+    limbs, c = _carry_limbs(t)
+    a2 = limbs
+    return jnp.where(_geq_p(a2)[..., None], _sub_p(a2), a2)
+
+
+def sub(a, b):
+    """a - b mod p; inputs canonical."""
+    # a + (2^406-style padding): add p first, then subtract b with borrow
+    t = a + _P_LIMBS_J
+    limbs, _ = _carry_limbs(t)
+    outs = []
+    borrow = jnp.zeros(a.shape[:-1], dtype=jnp.uint64)
+    two = jnp.uint64(1 << LIMB_BITS)
+    for k in range(NUM_LIMBS):
+        cur = limbs[..., k] + two - b[..., k] - borrow
+        outs.append(cur & jnp.uint64(MASK))
+        borrow = jnp.uint64(1) - (cur >> jnp.uint64(LIMB_BITS))
+    r = jnp.stack(outs, axis=-1)
+    r = jnp.where(_geq_p(r)[..., None], _sub_p(r), r)
+    return r
+
+
+def neg(a):
+    zero = jnp.zeros_like(a)
+    return sub(zero, a)
+
+
+def is_zero(a):
+    return jnp.all(a == 0, axis=-1)
+
+
+def eq(a, b):
+    return jnp.all(a == b, axis=-1)
+
+
+def select(cond, a, b):
+    """cond ? a : b, broadcasting cond over the limb dim."""
+    return jnp.where(cond[..., None], a, b)
+
+
+def zeros_like_batch(batch_shape):
+    return jnp.zeros(tuple(batch_shape) + (NUM_LIMBS,), dtype=jnp.uint64)
+
+
+def const(x_int, batch_shape=()):
+    """Montgomery-form constant broadcast to a batch shape."""
+    c = jnp.asarray(to_mont_int(x_int % P), dtype=jnp.uint64)
+    return jnp.broadcast_to(c, tuple(batch_shape) + (NUM_LIMBS,))

@@ -1,0 +1,213 @@
+"""Fq2 and Fq12 tower arithmetic in JAX, over the limb base field (ops.fq).
+
+Fq2 = Fq[u]/(u^2+1): shape (..., 2, 14).
+
+Fq12 is represented FLAT as Fq[w]/(w^12 - 2w^6 + 2): shape (..., 12, 14).
+(w^6 = 1+u = xi, so (w^6-1)^2 = -1 — same field as the oracle's 2-3-2 tower,
+different basis.) The flat basis makes an Fq12 multiply ONE batched 144-way
+Fq multiply + linear reduction, so the XLA graph stays small and the work
+lands in vectorized tensor ops — the TPU-first layout.
+
+Host-side converters map oracle tower elements <-> w-basis limb arrays.
+"""
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.bls12_381 import Fq2 as OFq2
+from ..utils.bls12_381 import Fq6 as OFq6
+from ..utils.bls12_381 import Fq12 as OFq12
+from ..utils.bls12_381 import P
+from . import fq
+
+# ---------------------------------------------------------------------------
+# Fq2: (..., 2, 14)
+# ---------------------------------------------------------------------------
+
+
+def fq2_add(a, b):
+    return fq.add(a, b)
+
+
+def fq2_sub(a, b):
+    return fq.sub(a, b)
+
+
+def fq2_neg(a):
+    return fq.neg(a)
+
+
+def fq2_mul(a, b):
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    b0, b1 = b[..., 0, :], b[..., 1, :]
+    t0 = fq.mont_mul(a0, b0)
+    t1 = fq.mont_mul(a1, b1)
+    t2 = fq.mont_mul(fq.add(a0, a1), fq.add(b0, b1))
+    c0 = fq.sub(t0, t1)
+    c1 = fq.sub(t2, fq.add(t0, t1))
+    return jnp.stack([c0, c1], axis=-2)
+
+
+def fq2_square(a):
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    c0 = fq.mont_mul(fq.add(a0, a1), fq.sub(a0, a1))
+    c1 = fq.mont_mul(a0, a1)
+    c1 = fq.add(c1, c1)
+    return jnp.stack([c0, c1], axis=-2)
+
+
+def fq2_mul_scalar(a, s):
+    """Multiply Fq2 by an Fq scalar (shape (...,14))."""
+    return fq.mont_mul(a, s[..., None, :])
+
+
+def fq2_is_zero(a):
+    return jnp.all(a == 0, axis=(-1, -2))
+
+
+def fq2_eq(a, b):
+    return jnp.all(a == b, axis=(-1, -2))
+
+
+def fq2_select(cond, a, b):
+    return jnp.where(cond[..., None, None], a, b)
+
+
+def fq2_double(a):
+    return fq.add(a, a)
+
+
+def fq2_const(c0_int, c1_int, batch_shape=()):
+    arr = np.stack([fq.to_mont_int(c0_int % P), fq.to_mont_int(c1_int % P)])
+    return jnp.broadcast_to(jnp.asarray(arr), tuple(batch_shape) + (2, fq.NUM_LIMBS))
+
+
+def fq2_from_oracle(x: OFq2, batch_shape=()):
+    return fq2_const(x.c0, x.c1, batch_shape)
+
+
+def fq2_to_oracle(a) -> OFq2:
+    a = np.asarray(a)
+    return OFq2(fq.from_mont_limbs(a[..., 0, :]), fq.from_mont_limbs(a[..., 1, :]))
+
+
+# ---------------------------------------------------------------------------
+# Fq12 flat basis: (..., 12, 14)
+# ---------------------------------------------------------------------------
+
+# Precomputed (i, j) index lists per output column k = i + j
+_CONV_IDX = [[(i, k - i) for i in range(12) if 0 <= k - i < 12] for k in range(23)]
+
+
+def fq12_mul(a, b):
+    # all 144 cross products in one batched Montgomery multiply
+    prod = fq.mont_mul(a[..., :, None, :], b[..., None, :, :])  # (...,12,12,14)
+    cols = []
+    for k in range(23):
+        idx = _CONV_IDX[k]
+        acc = prod[..., idx[0][0], idx[0][1], :]
+        for (i, j) in idx[1:]:
+            acc = fq.add(acc, prod[..., i, j, :])
+        cols.append(acc)
+    # reduce degrees 22..12 via w^12 = 2w^6 - 2
+    for k in range(22, 11, -1):
+        c = cols[k]
+        c2 = fq.add(c, c)
+        cols[k - 6] = fq.add(cols[k - 6], c2)
+        cols[k - 12] = fq.sub(cols[k - 12], c2)
+    return jnp.stack(cols[:12], axis=-2)
+
+
+def fq12_square(a):
+    return fq12_mul(a, a)
+
+
+def fq12_add(a, b):
+    return fq.add(a, b)
+
+
+def fq12_sub(a, b):
+    return fq.sub(a, b)
+
+
+def fq12_conjugate(a):
+    """x -> x^(p^6): negate odd-degree w coefficients."""
+    sign = np.array([1, -1] * 6)
+    outs = [a[..., k, :] if sign[k] == 1 else fq.neg(a[..., k, :]) for k in range(12)]
+    return jnp.stack(outs, axis=-2)
+
+
+def fq12_one(batch_shape=()):
+    arr = np.zeros((12, fq.NUM_LIMBS), dtype=np.uint64)
+    arr[0] = fq.ONE_MONT
+    return jnp.broadcast_to(jnp.asarray(arr), tuple(batch_shape) + (12, fq.NUM_LIMBS))
+
+
+def fq12_is_one(a):
+    one = fq12_one(a.shape[:-2])
+    return jnp.all(a == one, axis=(-1, -2))
+
+
+def fq12_select(cond, a, b):
+    return jnp.where(cond[..., None, None], a, b)
+
+
+# sparse line embedding: a line is l0 + l3*w^3-ish in tower terms; we build a
+# full 12-coefficient element from three Fq2 components at tower positions
+# 1 (c00), v*w (c11), v^2*w (c12) — see ops.pairing for the derivation.
+
+
+def fq12_from_tower_components(c00, c11w, c12w):
+    """Build flat Fq12 from Fq2 components at tower basis slots:
+    c00 at 1, c11w at v*w (= w^3), c12w at v^2*w (= w^5).
+
+    Tower->flat for an Fq2 element (a + b*u) at w^k: a-b at w^k, b at w^(k+6).
+    """
+    batch = c00.shape[:-2]
+    zero = fq.zeros_like_batch(batch)
+    cols = [zero] * 12
+
+    def place(fq2_el, k):
+        a_, b_ = fq2_el[..., 0, :], fq2_el[..., 1, :]
+        cols[k] = fq.add(cols[k], fq.sub(a_, b_))
+        cols[(k + 6) % 12] = fq.add(cols[(k + 6) % 12], b_) if k + 6 < 12 else cols[(k + 6) % 12]
+        if k + 6 >= 12:
+            raise ValueError("unsupported placement")
+
+    place(c00, 0)
+    place(c11w, 3)
+    place(c12w, 5)
+    return jnp.stack(cols, axis=-2)
+
+
+# ---------------------------------------------------------------------------
+# host conversions oracle tower <-> flat basis
+# ---------------------------------------------------------------------------
+
+
+def fq12_from_oracle(x: OFq12, batch_shape=()) -> jnp.ndarray:
+    """Tower (c0 + c1 v + c2 v^2) + (d0 + d1 v + d2 v^2) w -> w-basis coeffs."""
+    coeffs = [0] * 12
+    for half, fq6el in enumerate((x.c0, x.c1)):  # w^0 / w^1 halves
+        for vi, fq2el in enumerate((fq6el.c0, fq6el.c1, fq6el.c2)):  # v^vi = w^(2 vi)
+            k = 2 * vi + half
+            a_, b_ = fq2el.c0, fq2el.c1
+            coeffs[k] = (coeffs[k] + a_ - b_) % P
+            coeffs[k + 6] = (coeffs[k + 6] + b_) % P
+    arr = np.stack([fq.to_mont_int(c) for c in coeffs])
+    return jnp.broadcast_to(jnp.asarray(arr), tuple(batch_shape) + (12, fq.NUM_LIMBS))
+
+
+def fq12_to_oracle(a) -> OFq12:
+    a = np.asarray(a)
+    coeffs = [fq.from_mont_limbs(a[..., k, :]) for k in range(12)]
+    # invert the basis map: at slot k (k<6): value a-b, at k+6: b
+    sixes = []
+    for half in range(2):
+        fq2s = []
+        for vi in range(3):
+            k = 2 * vi + half
+            b_ = coeffs[k + 6]
+            a_ = (coeffs[k] + b_) % P
+            fq2s.append(OFq2(a_, b_))
+        sixes.append(OFq6(*fq2s))
+    return OFq12(sixes[0], sixes[1])

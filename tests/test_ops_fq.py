@@ -1,0 +1,67 @@
+"""JAX Fq limb arithmetic vs the pure-Python oracle, bit-exact."""
+import random
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from consensus_specs_tpu.ops import fq  # noqa: E402  (enables x64)
+from consensus_specs_tpu.utils.bls12_381 import P  # noqa: E402
+
+rng = random.Random(7)
+
+
+def rand_fq():
+    return rng.randrange(P)
+
+
+def test_limb_roundtrip():
+    for _ in range(10):
+        x = rand_fq()
+        limbs = fq.to_mont_int(x)
+        assert fq.from_mont_limbs(limbs) == x
+
+
+def test_mont_mul_matches_oracle():
+    xs = [0, 1, 2, P - 1, P - 2] + [rand_fq() for _ in range(20)]
+    ys = [1, 0, P - 1, 3, P // 2] + [rand_fq() for _ in range(20)]
+    a = np.stack([fq.to_mont_int(x) for x in xs])
+    b = np.stack([fq.to_mont_int(y) for y in ys])
+    out = np.asarray(fq.mont_mul(a, b))
+    for i, (x, y) in enumerate(zip(xs, ys)):
+        assert fq.from_mont_limbs(out[i]) == (x * y) % P, f"mismatch at {i}"
+
+
+def test_add_sub_neg():
+    xs = [rand_fq() for _ in range(16)]
+    ys = [rand_fq() for _ in range(16)]
+    a = np.stack([fq.to_mont_int(x) for x in xs])
+    b = np.stack([fq.to_mont_int(y) for y in ys])
+    s = np.asarray(fq.add(a, b))
+    d = np.asarray(fq.sub(a, b))
+    n = np.asarray(fq.neg(a))
+    for i, (x, y) in enumerate(zip(xs, ys)):
+        assert fq.from_mont_limbs(s[i]) == (x + y) % P
+        assert fq.from_mont_limbs(d[i]) == (x - y) % P
+        assert fq.from_mont_limbs(n[i]) == (-x) % P
+
+
+def test_edge_zero_and_one():
+    one = fq.const(1)
+    zero = fq.const(0)
+    x = fq.to_mont_int(rand_fq())
+    assert fq.from_mont_limbs(np.asarray(fq.mont_mul(x, one))) == fq.from_mont_limbs(x)
+    assert fq.from_mont_limbs(np.asarray(fq.mont_mul(x, zero))) == 0
+    assert bool(np.asarray(fq.is_zero(np.asarray(zero))))
+
+
+def test_mont_mul_jit_and_batch():
+    f = jax.jit(fq.mont_mul)
+    xs = [rand_fq() for _ in range(64)]
+    ys = [rand_fq() for _ in range(64)]
+    a = np.stack([fq.to_mont_int(x) for x in xs])
+    b = np.stack([fq.to_mont_int(y) for y in ys])
+    out = np.asarray(f(a, b))
+    for i in range(64):
+        assert fq.from_mont_limbs(out[i]) == (xs[i] * ys[i]) % P

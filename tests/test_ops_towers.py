@@ -1,0 +1,78 @@
+"""JAX tower arithmetic vs the oracle: Fq2 and flat-basis Fq12."""
+import random
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from consensus_specs_tpu.ops import fq, towers  # noqa: E402
+from consensus_specs_tpu.utils.bls12_381 import (  # noqa: E402
+    Fq2, Fq6, Fq12, P,
+)
+
+rng = random.Random(11)
+
+# jit once — eager per-op dispatch is far too slow for thousand-op graphs
+_fq2_mul = jax.jit(towers.fq2_mul)
+_fq2_square = jax.jit(towers.fq2_square)
+_fq2_add = jax.jit(towers.fq2_add)
+_fq2_sub = jax.jit(towers.fq2_sub)
+_fq12_mul = jax.jit(towers.fq12_mul)
+_fq12_conj = jax.jit(towers.fq12_conjugate)
+
+
+def rand_fq2():
+    return Fq2(rng.randrange(P), rng.randrange(P))
+
+
+def rand_fq12():
+    def rand_fq6():
+        return Fq6(rand_fq2(), rand_fq2(), rand_fq2())
+
+    return Fq12(rand_fq6(), rand_fq6())
+
+
+def test_fq2_mul_matches_oracle():
+    for _ in range(8):
+        x, y = rand_fq2(), rand_fq2()
+        a = towers.fq2_from_oracle(x)
+        b = towers.fq2_from_oracle(y)
+        assert towers.fq2_to_oracle(np.asarray(_fq2_mul(a, b))) == x * y
+        assert towers.fq2_to_oracle(np.asarray(_fq2_square(a))) == x * x
+        assert towers.fq2_to_oracle(np.asarray(_fq2_add(a, b))) == x + y
+        assert towers.fq2_to_oracle(np.asarray(_fq2_sub(a, b))) == x - y
+
+
+def test_fq12_roundtrip():
+    for _ in range(4):
+        x = rand_fq12()
+        a = towers.fq12_from_oracle(x)
+        assert towers.fq12_to_oracle(np.asarray(a)) == x
+
+
+def test_fq12_mul_matches_oracle():
+    for _ in range(6):
+        x, y = rand_fq12(), rand_fq12()
+        a = towers.fq12_from_oracle(x)
+        b = towers.fq12_from_oracle(y)
+        got = towers.fq12_to_oracle(np.asarray(_fq12_mul(a, b)))
+        assert got == x * y
+
+
+def test_fq12_conjugate_matches_oracle():
+    for _ in range(4):
+        x = rand_fq12()
+        a = towers.fq12_from_oracle(x)
+        got = towers.fq12_to_oracle(np.asarray(_fq12_conj(a)))
+        assert got == x.conjugate()
+
+
+def test_fq12_one():
+    one = towers.fq12_one()
+    assert towers.fq12_to_oracle(np.asarray(one)) == Fq12.one()
+    x = rand_fq12()
+    a = towers.fq12_from_oracle(x)
+    assert towers.fq12_to_oracle(np.asarray(_fq12_mul(a, one))) == x
+    assert bool(np.asarray(towers.fq12_is_one(_fq12_mul(a, one))) ) is False or x == Fq12.one()
+    assert bool(np.asarray(towers.fq12_is_one(one)))
