@@ -1,0 +1,159 @@
+"""Jacobian curve arithmetic in JAX for G1 (over Fq) and G2 (over Fq2).
+
+Points are dicts of coordinate arrays {x, y, z} (Jacobian; z == 0 encodes
+infinity), batched over leading dims. All control flow is branchless
+(select-based) so everything jits and scans.
+
+Formulas match the oracle's (utils/bls12_381.py ec_double/ec_add) and are
+cross-checked against it in tests/test_ops_curve.py.
+"""
+import jax.numpy as jnp
+
+from . import fq
+from . import towers as tw
+
+# field-op namespaces so the same formulas serve G1 (Fq) and G2 (Fq2)
+
+
+class _FqOps:
+    mul = staticmethod(fq.mont_mul)
+    add = staticmethod(fq.add)
+    sub = staticmethod(fq.sub)
+    neg = staticmethod(fq.neg)
+    is_zero = staticmethod(fq.is_zero)
+    select = staticmethod(fq.select)
+
+    @staticmethod
+    def square(a):
+        return fq.mont_mul(a, a)
+
+    @staticmethod
+    def zeros_like(a):
+        return jnp.zeros_like(a)
+
+
+class _Fq2Ops:
+    mul = staticmethod(tw.fq2_mul)
+    add = staticmethod(tw.fq2_add)
+    sub = staticmethod(tw.fq2_sub)
+    neg = staticmethod(tw.fq2_neg)
+    square = staticmethod(tw.fq2_square)
+    is_zero = staticmethod(tw.fq2_is_zero)
+    select = staticmethod(tw.fq2_select)
+
+    @staticmethod
+    def zeros_like(a):
+        return jnp.zeros_like(a)
+
+
+FQ_OPS = _FqOps
+FQ2_OPS = _Fq2Ops
+
+
+def point(x, y, z):
+    return {"x": x, "y": y, "z": z}
+
+
+def point_select(F, cond, p1, p2):
+    return {k: F.select(cond, p1[k], p2[k]) for k in ("x", "y", "z")}
+
+
+def is_infinity(F, pt):
+    return F.is_zero(pt["z"])
+
+
+def double(F, pt):
+    """Jacobian doubling, a = 0 (matches oracle ec_double)."""
+    X, Y, Z = pt["x"], pt["y"], pt["z"]
+    A = F.square(X)
+    B = F.square(Y)
+    C = F.square(B)
+    t = F.add(X, B)
+    t2 = F.sub(F.sub(F.square(t), A), C)
+    D = F.add(t2, t2)
+    E = F.add(F.add(A, A), A)
+    Fv = F.square(E)
+    X3 = F.sub(Fv, F.add(D, D))
+    C8 = F.add(F.add(F.add(C, C), F.add(C, C)), F.add(F.add(C, C), F.add(C, C)))
+    Y3 = F.sub(F.mul(E, F.sub(D, X3)), C8)
+    YZ = F.mul(Y, Z)
+    Z3 = F.add(YZ, YZ)
+    # doubling a point with Y == 0 (or infinity) -> infinity (z3 == 0 handled
+    # naturally since Z3 = 2YZ)
+    return point(X3, Y3, Z3)
+
+
+def add_mixed(F, pt, qx, qy):
+    """Jacobian + affine addition, branchless.
+
+    Handles: pt at infinity -> Q; pt == Q -> double; pt == -Q -> infinity.
+    """
+    X, Y, Z = pt["x"], pt["y"], pt["z"]
+    Z2 = F.square(Z)
+    Z3 = F.mul(Z2, Z)
+    U2 = F.mul(qx, Z2)
+    S2 = F.mul(qy, Z3)
+    H = F.sub(U2, X)  # x difference
+    R = F.sub(S2, Y)  # y difference
+    H2 = F.square(H)
+    H3 = F.mul(H2, H)
+    V = F.mul(X, H2)
+    R2 = F.square(R)
+    X3 = F.sub(F.sub(R2, H3), F.add(V, V))
+    Y3 = F.sub(F.mul(R, F.sub(V, X3)), F.mul(Y, H3))
+    Z3n = F.mul(Z, H)
+    out = point(X3, Y3, Z3n)
+
+    # special cases
+    h_zero = F.is_zero(H)
+    r_zero = F.is_zero(R)
+    # pt == Q: double instead
+    dbl = double(F, pt)
+    out = point_select(F, h_zero & r_zero, dbl, out)
+    # pt == -Q: infinity (z = 0)
+    inf_pt = point(F.zeros_like(X), F.zeros_like(Y), F.zeros_like(Z))
+    out = point_select(F, h_zero & ~r_zero, inf_pt, out)
+    # pt at infinity: Q (affine -> jacobian with z = 1)
+    one = _field_one(F, X)
+    q_jac = point(qx, qy, one)
+    out = point_select(F, is_infinity(F, pt), q_jac, out)
+    return out
+
+
+def _field_one(F, like):
+    if F is FQ_OPS:
+        return jnp.broadcast_to(jnp.asarray(fq.ONE_MONT), like.shape)
+    # Fq2 one
+    return tw.fq2_const(1, 0, like.shape[:-2])
+
+
+def scalar_mul_fixed(F, qx, qy, scalar_bits):
+    """(scalar)·Q for affine Q, via branchless double-and-add over the STATIC
+    msb-first bit string `scalar_bits` (python list). Returns Jacobian point."""
+    import jax
+
+    zeros_x = F.zeros_like(qx)
+    zeros_y = F.zeros_like(qy)
+    if F is FQ_OPS:
+        zeros_z = jnp.zeros_like(qx)
+    else:
+        zeros_z = jnp.zeros_like(qx)
+    acc = point(zeros_x, zeros_y, zeros_z)  # infinity
+
+    bits_arr = jnp.asarray(scalar_bits, dtype=bool)
+
+    def body(acc, bit):
+        acc = double(F, acc)
+        added = add_mixed(F, acc, qx, qy)
+        acc = point_select(F, jnp.broadcast_to(bit, is_infinity(F, acc).shape), added, acc)
+        return acc, None
+
+    acc, _ = jax.lax.scan(body, acc, bits_arr)
+    return acc
+
+
+def subgroup_check_bits():
+    """MSB-first bits of the curve order r."""
+    from ..utils.bls12_381 import R
+
+    return [int(b) for b in bin(R)[2:]]

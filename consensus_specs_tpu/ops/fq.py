@@ -1,26 +1,38 @@
 """Base-field (Fq) limb arithmetic for BLS12-381 in JAX.
 
-Representation: an Fq element is an array of shape (..., 14) of uint64 limbs,
-29 bits per limb (14*29 = 406 bits), in Montgomery form with R = 2^406.
+Representation: an Fq element is an array of shape (..., 15) of uint64 limbs,
+28 bits per limb (15*28 = 420 bits), in Montgomery form with R = 2^420.
+The ~39 bits of headroom above p (2^381) make lazy-reduction bounds easy:
+a Montgomery multiply of any two values < 2^401 contracts to < 2^383, sums
+of <= 16 such stay < 2^387, and the borrowless subtract shift (MP ~ 2^400)
+keeps every intermediate far below the 2^420 capacity.
 All operations are batched over leading dims — parallelism lives in the batch
 dimensions, keeping the XLA graph size independent of batch size.
 
-Montgomery multiply is CIOS with delayed carries: products are < 2^58, each
-accumulator column absorbs at most ~28 products before being shifted out, so
-uint64 never overflows (28 * 2^58 < 2^63).
+LAZY REDUCTION: values are kept loosely reduced (any representative of the
+residue class below ~2^405, limbs always < 2^29). No per-op compare/subtract
+chains — only carry propagation. Bounds:
+- mont_mul inputs a, b < 2^401  =>  output < a*b/2^420 + p < 2^383
+- `canonical()` (one extra Montgomery multiply by the representation of 1 +
+  a single conditional subtract) produces the unique value in [0, p) — used
+  only for equality/zero tests and host export.
 
-Cross-checked bit-exactly against the pure-Python oracle
-(consensus_specs_tpu.utils.bls12_381) in tests/test_ops_fq.py.
+Montgomery multiply is CIOS with delayed carries: limb products are < 2^56
+and each accumulator column absorbs < 64 of them before being shifted out,
+so uint64 never overflows.
+
+Cross-checked bit-exactly (mod p) against the pure-Python oracle in
+tests/test_ops_fq.py.
 """
 import jax.numpy as jnp
 import numpy as np
 
 from ..utils.bls12_381 import P
 
-LIMB_BITS = 29
-NUM_LIMBS = 14
+LIMB_BITS = 28
+NUM_LIMBS = 15
 MASK = (1 << LIMB_BITS) - 1
-R_BITS = LIMB_BITS * NUM_LIMBS  # 406
+R_BITS = LIMB_BITS * NUM_LIMBS  # 420
 R_MONT = 1 << R_BITS
 
 
@@ -44,9 +56,17 @@ def limbs_to_int(limbs) -> int:
 P_LIMBS = _int_to_limbs_np(P)
 N0 = (-pow(P, -1, 1 << LIMB_BITS)) % (1 << LIMB_BITS)  # -p^-1 mod 2^29
 R_MOD_P = R_MONT % P
-R2_MOD_P = (R_MONT * R_MONT) % P
 ONE_MONT = _int_to_limbs_np(R_MOD_P)  # 1 in Montgomery form
 ZERO = np.zeros(NUM_LIMBS, dtype=np.uint64)
+# MP: multiple of p used as the additive shift in borrowless subtraction;
+# smallest multiple of p above 2^402 (sub compresses its b operand to < 2^382
+# first, and loose a operands stay far below 2^410)
+MP = ((1 << 402) // P + 1) * P
+MP_LIMBS = _int_to_limbs_np(MP)
+
+_P_LIMBS_J = jnp.asarray(P_LIMBS, dtype=jnp.uint64)
+_MP_LIMBS_J = jnp.asarray(MP_LIMBS, dtype=jnp.uint64)
+_ONE_MONT_J = jnp.asarray(ONE_MONT, dtype=jnp.uint64)
 
 
 def to_mont_int(x: int) -> np.ndarray:
@@ -55,17 +75,28 @@ def to_mont_int(x: int) -> np.ndarray:
 
 
 def from_mont_limbs(limbs) -> int:
-    """Host: decode Montgomery-form limbs back to an integer < p."""
+    """Host: decode (possibly loose) Montgomery-form limbs to an int < p."""
     x = limbs_to_int(limbs)
     return (x * pow(R_MONT, -1, P)) % P
 
 
-_P_LIMBS_J = jnp.asarray(P_LIMBS, dtype=jnp.uint64)
+def _carry_limbs(t, out_limbs=NUM_LIMBS):
+    """Propagate carries to limbs < 2^29; the value must fit out_limbs limbs."""
+    n = t.shape[-1]
+    outs = []
+    c = jnp.zeros(t.shape[:-1], dtype=jnp.uint64)
+    for k in range(n):
+        cur = t[..., k] + c
+        outs.append(cur & jnp.uint64(MASK))
+        c = cur >> jnp.uint64(LIMB_BITS)
+    while len(outs) < out_limbs:
+        outs.append(c & jnp.uint64(MASK))
+        c = c >> jnp.uint64(LIMB_BITS)
+    return jnp.stack(outs[:out_limbs], axis=-1)
 
 
 def mont_mul(a, b):
-    """Montgomery product a*b*R^-1 mod p; inputs/outputs canonical (< p),
-    limbs < 2^29. Shapes broadcast over leading dims."""
+    """Montgomery product a*b*R^-1 (mod p); loose in, loose out."""
     a = jnp.asarray(a, jnp.uint64)
     b = jnp.asarray(b, jnp.uint64)
     shape = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
@@ -77,30 +108,55 @@ def mont_mul(a, b):
         t = t.at[..., :NUM_LIMBS].add(ai * b)
         m = ((t[..., 0] & mask) * n0) & mask
         t = t.at[..., :NUM_LIMBS].add(m[..., None] * _P_LIMBS_J)
-        # t[...,0] is divisible by 2^29; shift one limb down, carrying the
+        # t[...,0] now divisible by 2^29; shift one limb down, carrying the
         # high bits of t[...,0] into the new lowest limb
         carry = t[..., 0] >> jnp.uint64(LIMB_BITS)
         t = jnp.concatenate(
             [t[..., 1:], jnp.zeros(shape + (1,), dtype=jnp.uint64)], axis=-1
         )
         t = t.at[..., 0].add(carry)
-    return _canonicalize(t)
+    return _carry_limbs(t)
 
 
-def _carry_limbs(t):
-    """Propagate carries so limbs < 2^29 (keeps total value)."""
-    n = t.shape[-1]
-    outs = []
-    c = jnp.zeros(t.shape[:-1], dtype=jnp.uint64)
-    for k in range(n):
-        cur = t[..., k] + c
-        outs.append(cur & jnp.uint64(MASK))
-        c = cur >> jnp.uint64(LIMB_BITS)
-    return jnp.stack(outs, axis=-1), c
+def add(a, b):
+    return _carry_limbs(a + b)
+
+
+def add_many(terms):
+    """Sum a list of loose elements (raw limb accumulation + one carry pass)."""
+    acc = terms[0]
+    for t in terms[1:]:
+        acc = acc + t
+    return _carry_limbs(acc)
+
+
+def compress(a):
+    """Value-preserving magnitude reduction: one Montgomery multiply by the
+    representation of 1 contracts any loose value to < 2^382."""
+    return mont_mul(a, _ONE_MONT_J)
+
+
+def sub(a, b):
+    """a - b (mod p), borrowless, via the base-2^28 complement identity:
+    a + MP + comp(b) + 1 == a + MP - b + 2^420.
+
+    b is compressed first so MP > b always holds regardless of how loose the
+    incoming chain value is; a may be loose (< ~2^410). The overflow limb of
+    the complement identity is then exactly 1 and is dropped."""
+    b = compress(b)
+    nb = jnp.uint64(MASK) - b  # limbs < 2^28, no wrap
+    t = a + _MP_LIMBS_J + nb
+    t = t.at[..., 0].add(jnp.uint64(1))
+    limbs = _carry_limbs(t, out_limbs=NUM_LIMBS + 1)
+    # drop the 2^420 overflow bit from the complement identity
+    return limbs[..., :NUM_LIMBS]
+
+
+def neg(a):
+    return sub(jnp.zeros_like(a), a)
 
 
 def _geq_p(a):
-    """a >= p for 14-limb canonical-limbed a (lexicographic from the top)."""
     ge = jnp.ones(a.shape[:-1], dtype=bool)
     gt = jnp.zeros(a.shape[:-1], dtype=bool)
     for k in reversed(range(NUM_LIMBS)):
@@ -111,7 +167,6 @@ def _geq_p(a):
 
 
 def _sub_p(a):
-    """a - p with borrow chain (assumes a >= p), limbs stay < 2^29."""
     outs = []
     borrow = jnp.zeros(a.shape[:-1], dtype=jnp.uint64)
     two29 = jnp.uint64(1 << LIMB_BITS)
@@ -123,57 +178,23 @@ def _sub_p(a):
     return jnp.stack(outs, axis=-1)
 
 
-def _canonicalize(t):
-    """Carry-propagate a (...,15) accumulator and reduce into [0, p)."""
-    limbs, c = _carry_limbs(t)
-    # Montgomery output < 2p for canonical inputs; extra top limb/carry is 0
-    a = limbs[..., :NUM_LIMBS]
-    extra = limbs[..., NUM_LIMBS:].sum(axis=-1) + c if limbs.shape[-1] > NUM_LIMBS else c
-    # fold any stray top bit back (should not occur for canonical inputs)
-    a = jnp.where(_geq_p(a)[..., None], _sub_p(a), a)
-    del extra
-    return a
-
-
-def add(a, b):
-    t = a + b
-    limbs, c = _carry_limbs(t)
-    a2 = limbs
-    return jnp.where(_geq_p(a2)[..., None], _sub_p(a2), a2)
-
-
-def sub(a, b):
-    """a - b mod p; inputs canonical."""
-    # a + (2^406-style padding): add p first, then subtract b with borrow
-    t = a + _P_LIMBS_J
-    limbs, _ = _carry_limbs(t)
-    outs = []
-    borrow = jnp.zeros(a.shape[:-1], dtype=jnp.uint64)
-    two = jnp.uint64(1 << LIMB_BITS)
-    for k in range(NUM_LIMBS):
-        cur = limbs[..., k] + two - b[..., k] - borrow
-        outs.append(cur & jnp.uint64(MASK))
-        borrow = jnp.uint64(1) - (cur >> jnp.uint64(LIMB_BITS))
-    r = jnp.stack(outs, axis=-1)
-    r = jnp.where(_geq_p(r)[..., None], _sub_p(r), r)
-    return r
-
-
-def neg(a):
-    zero = jnp.zeros_like(a)
-    return sub(zero, a)
+def canonical(a):
+    """The unique representative in [0, p): one Montgomery multiply by
+    repr(1) (output < p + eps) + a single conditional subtract."""
+    r = mont_mul(a, _ONE_MONT_J)
+    return jnp.where(_geq_p(r)[..., None], _sub_p(r), r)
 
 
 def is_zero(a):
-    return jnp.all(a == 0, axis=-1)
+    """Mod-p zero test (canonicalizes internally)."""
+    return jnp.all(canonical(a) == 0, axis=-1)
 
 
 def eq(a, b):
-    return jnp.all(a == b, axis=-1)
+    return jnp.all(canonical(a) == canonical(b), axis=-1)
 
 
 def select(cond, a, b):
-    """cond ? a : b, broadcasting cond over the limb dim."""
     return jnp.where(cond[..., None], a, b)
 
 
@@ -182,6 +203,5 @@ def zeros_like_batch(batch_shape):
 
 
 def const(x_int, batch_shape=()):
-    """Montgomery-form constant broadcast to a batch shape."""
     c = jnp.asarray(to_mont_int(x_int % P), dtype=jnp.uint64)
     return jnp.broadcast_to(c, tuple(batch_shape) + (NUM_LIMBS,))

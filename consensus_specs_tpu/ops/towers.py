@@ -60,12 +60,16 @@ def fq2_mul_scalar(a, s):
     return fq.mont_mul(a, s[..., None, :])
 
 
+def fq2_canonical(a):
+    return fq.canonical(a)
+
+
 def fq2_is_zero(a):
-    return jnp.all(a == 0, axis=(-1, -2))
+    return jnp.all(fq.canonical(a) == 0, axis=(-1, -2))
 
 
 def fq2_eq(a, b):
-    return jnp.all(a == b, axis=(-1, -2))
+    return jnp.all(fq.canonical(a) == fq.canonical(b), axis=(-1, -2))
 
 
 def fq2_select(cond, a, b):
@@ -100,14 +104,14 @@ _CONV_IDX = [[(i, k - i) for i in range(12) if 0 <= k - i < 12] for k in range(2
 
 def fq12_mul(a, b):
     # all 144 cross products in one batched Montgomery multiply
-    prod = fq.mont_mul(a[..., :, None, :], b[..., None, :, :])  # (...,12,12,14)
+    prod = fq.mont_mul(a[..., :, None, :], b[..., None, :, :])  # (...,12,12,L)
     cols = []
     for k in range(23):
         idx = _CONV_IDX[k]
         acc = prod[..., idx[0][0], idx[0][1], :]
         for (i, j) in idx[1:]:
-            acc = fq.add(acc, prod[..., i, j, :])
-        cols.append(acc)
+            acc = acc + prod[..., i, j, :]  # raw limb sums (<= 12 terms)
+        cols.append(fq._carry_limbs(acc))
     # reduce degrees 22..12 via w^12 = 2w^6 - 2
     for k in range(22, 11, -1):
         c = cols[k]
@@ -144,7 +148,7 @@ def fq12_one(batch_shape=()):
 
 def fq12_is_one(a):
     one = fq12_one(a.shape[:-2])
-    return jnp.all(a == one, axis=(-1, -2))
+    return jnp.all(fq.canonical(a) == fq.canonical(one), axis=(-1, -2))
 
 
 def fq12_select(cond, a, b):

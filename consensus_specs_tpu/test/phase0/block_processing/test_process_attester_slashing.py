@@ -1,0 +1,164 @@
+"""process_attester_slashing handler tests
+(reference: test/phase0/block_processing/test_process_attester_slashing.py)."""
+from ...context import always_bls, never_bls, spec_state_test, with_all_phases
+from ...helpers.attestations import sign_indexed_attestation
+from ...helpers.attester_slashings import (
+    get_indexed_attestation_participants, get_valid_attester_slashing,
+    run_attester_slashing_processing,
+)
+from ...helpers.state import next_epoch
+
+
+@with_all_phases
+@spec_state_test
+def test_success_double(spec, state):
+    attester_slashing = get_valid_attester_slashing(spec, state, signed_1=True, signed_2=True)
+
+    yield from run_attester_slashing_processing(spec, state, attester_slashing)
+
+
+@with_all_phases
+@spec_state_test
+def test_success_surround(spec, state):
+    next_epoch(spec, state)
+
+    state.current_justified_checkpoint.epoch += 1
+    attester_slashing = get_valid_attester_slashing(spec, state, signed_1=False, signed_2=True)
+    att_1 = attester_slashing.attestation_1
+    att_2 = attester_slashing.attestation_2
+
+    # set attestation1 to surround attestation 2
+    att_1.data.source.epoch = att_2.data.source.epoch - 1
+    att_1.data.target.epoch = att_2.data.target.epoch + 1
+
+    sign_indexed_attestation(spec, state, attester_slashing.attestation_1)
+
+    yield from run_attester_slashing_processing(spec, state, attester_slashing)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_success_already_exited_recent(spec, state):
+    attester_slashing = get_valid_attester_slashing(spec, state, signed_1=True, signed_2=True)
+    slashed_indices = get_indexed_attestation_participants(spec, attester_slashing.attestation_1)
+    for index in slashed_indices:
+        spec.initiate_validator_exit(state, index)
+
+    yield from run_attester_slashing_processing(spec, state, attester_slashing)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_sig_1(spec, state):
+    attester_slashing = get_valid_attester_slashing(spec, state, signed_1=False, signed_2=True)
+    yield from run_attester_slashing_processing(spec, state, attester_slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_sig_2(spec, state):
+    attester_slashing = get_valid_attester_slashing(spec, state, signed_1=True, signed_2=False)
+    yield from run_attester_slashing_processing(spec, state, attester_slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_sig_1_and_2(spec, state):
+    attester_slashing = get_valid_attester_slashing(spec, state, signed_1=False, signed_2=False)
+    yield from run_attester_slashing_processing(spec, state, attester_slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_same_data(spec, state):
+    attester_slashing = get_valid_attester_slashing(spec, state, signed_1=False, signed_2=True)
+
+    indexed_att_1 = attester_slashing.attestation_1
+    att_2_data = attester_slashing.attestation_2.data
+    indexed_att_1.data = att_2_data
+    sign_indexed_attestation(spec, state, attester_slashing.attestation_1)
+
+    yield from run_attester_slashing_processing(spec, state, attester_slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_no_double_or_surround(spec, state):
+    attester_slashing = get_valid_attester_slashing(spec, state, signed_1=False, signed_2=True)
+
+    attester_slashing.attestation_1.data.target.epoch += 1
+    sign_indexed_attestation(spec, state, attester_slashing.attestation_1)
+
+    yield from run_attester_slashing_processing(spec, state, attester_slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_participants_already_slashed(spec, state):
+    attester_slashing = get_valid_attester_slashing(spec, state, signed_1=True, signed_2=True)
+
+    # set all indices to slashed
+    validator_indices = get_indexed_attestation_participants(spec, attester_slashing.attestation_1)
+    for index in validator_indices:
+        state.validators[index].slashed = True
+
+    yield from run_attester_slashing_processing(spec, state, attester_slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_att1_high_index(spec, state):
+    attester_slashing = get_valid_attester_slashing(spec, state, signed_1=True, signed_2=True)
+
+    indices = get_indexed_attestation_participants(spec, attester_slashing.attestation_1)
+    indices.append(spec.ValidatorIndex(len(state.validators)))  # off by 1
+    attester_slashing.attestation_1.attesting_indices = indices
+
+    yield from run_attester_slashing_processing(spec, state, attester_slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_att1_empty_indices(spec, state):
+    attester_slashing = get_valid_attester_slashing(spec, state, signed_1=False, signed_2=True)
+
+    attester_slashing.attestation_1.attesting_indices = []
+    attester_slashing.attestation_1.signature = spec.bls.G2_POINT_AT_INFINITY
+
+    yield from run_attester_slashing_processing(spec, state, attester_slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_all_empty_indices(spec, state):
+    attester_slashing = get_valid_attester_slashing(spec, state, signed_1=False, signed_2=False)
+
+    attester_slashing.attestation_1.attesting_indices = []
+    attester_slashing.attestation_1.signature = spec.bls.G2_POINT_AT_INFINITY
+
+    attester_slashing.attestation_2.attesting_indices = []
+    attester_slashing.attestation_2.signature = spec.bls.G2_POINT_AT_INFINITY
+
+    yield from run_attester_slashing_processing(spec, state, attester_slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_unsorted_att_1(spec, state):
+    attester_slashing = get_valid_attester_slashing(spec, state, signed_1=False, signed_2=True)
+
+    indices = get_indexed_attestation_participants(spec, attester_slashing.attestation_1)
+    assert len(indices) >= 3
+    indices[1], indices[2] = indices[2], indices[1]  # unsort second and third index
+    attester_slashing.attestation_1.attesting_indices = indices
+    sign_indexed_attestation(spec, state, attester_slashing.attestation_1)
+
+    yield from run_attester_slashing_processing(spec, state, attester_slashing, valid=False)
