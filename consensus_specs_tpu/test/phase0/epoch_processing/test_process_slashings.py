@@ -1,0 +1,93 @@
+"""process_slashings tests
+(reference: test/phase0/epoch_processing/test_process_slashings.py)."""
+from ...context import spec_state_test, with_all_phases
+from ...helpers.epoch_processing import run_epoch_processing_to, run_epoch_processing_with
+
+
+def slash_validators(spec, state, indices, out_epochs):
+    total_slashed_balance = 0
+    for i, out_epoch in zip(indices, out_epochs):
+        v = state.validators[i]
+        v.slashed = True
+        spec.initiate_validator_exit(state, i)
+        v.withdrawable_epoch = out_epoch
+        total_slashed_balance += v.effective_balance
+
+    state.slashings[
+        spec.get_current_epoch(state) % spec.EPOCHS_PER_SLASHINGS_VECTOR
+    ] = total_slashed_balance
+
+
+def get_slashing_multiplier(spec):
+    if spec.fork == "merge":
+        return spec.PROPORTIONAL_SLASHING_MULTIPLIER_MERGE
+    if spec.fork == "altair":
+        return spec.PROPORTIONAL_SLASHING_MULTIPLIER_ALTAIR
+    return spec.PROPORTIONAL_SLASHING_MULTIPLIER
+
+
+@with_all_phases
+@spec_state_test
+def test_max_penalties(spec, state):
+    # slash enough validators that multiplier * slashed balance >= total balance,
+    # so the adjusted slashing balance saturates and penalties hit 100%
+    slashed_count = min(
+        len(state.validators),
+        len(state.validators) // get_slashing_multiplier(spec) + 1,
+    )
+    out_epoch = spec.get_current_epoch(state) + (spec.EPOCHS_PER_SLASHINGS_VECTOR // 2)
+
+    slashed_indices = list(range(slashed_count))
+    slash_validators(spec, state, slashed_indices, [out_epoch] * slashed_count)
+
+    total_balance = spec.get_total_active_balance(state)
+    total_penalties = sum(state.slashings)
+
+    assert total_balance // get_slashing_multiplier(spec) <= total_penalties
+
+    yield from run_epoch_processing_with(spec, state, 'process_slashings')
+
+    for i in slashed_indices:
+        assert state.balances[i] == 0
+
+
+@with_all_phases
+@spec_state_test
+def test_minimal_penalty(spec, state):
+    # Just the bare minimum for this one validator
+    state.balances[0] = state.validators[0].effective_balance = spec.config.EJECTION_BALANCE
+    # All the other validators get the maximum.
+    for i in range(1, len(state.validators)):
+        state.validators[i].effective_balance = state.balances[i] = spec.MAX_EFFECTIVE_BALANCE
+
+    out_epoch = spec.get_current_epoch(state) + (spec.EPOCHS_PER_SLASHINGS_VECTOR // 2)
+
+    slash_validators(spec, state, [0], [out_epoch])
+
+    total_balance = spec.get_total_active_balance(state)
+    total_penalties = sum(state.slashings)
+
+    assert total_balance // 3 > total_penalties
+
+    run_epoch_processing_to(spec, state, 'process_slashings')
+    pre_slash_balances = list(state.balances)
+
+    yield 'pre', state
+    spec.process_slashings(state)
+    yield 'post', state
+
+    expected_penalty = (
+        state.validators[0].effective_balance // spec.EFFECTIVE_BALANCE_INCREMENT
+        * (get_slashing_multiplier(spec) * total_penalties)
+        // total_balance
+        * spec.EFFECTIVE_BALANCE_INCREMENT
+    )
+
+    assert state.balances[0] == pre_slash_balances[0] - expected_penalty
+
+
+@with_all_phases
+@spec_state_test
+def test_empty_slashings(spec, state):
+    # no slashings, no penalties
+    yield from run_epoch_processing_with(spec, state, 'process_slashings')

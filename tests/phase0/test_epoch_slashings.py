@@ -1,0 +1,1 @@
+from consensus_specs_tpu.test.phase0.epoch_processing.test_process_slashings import *  # noqa: F401,F403

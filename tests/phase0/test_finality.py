@@ -1,0 +1,1 @@
+from consensus_specs_tpu.test.phase0.finality.test_finality import *  # noqa: F401,F403
