@@ -7,6 +7,19 @@ dimension maps onto TPU vector units and `shard_map` device meshes.
 
 x64 mode is required: limb arithmetic uses uint64 accumulators.
 """
+import os
+
 import jax
 
 jax.config.update("jax_enable_x64", True)
+
+# Persistent XLA compilation cache: VM step programs are compiled once per
+# shape bucket per machine, then loaded from disk (~ms) on later runs.
+_cache_dir = os.environ.get(
+    "CONSENSUS_SPECS_TPU_XLA_CACHE",
+    os.path.join(os.path.expanduser("~"), ".cache", "consensus_specs_tpu_xla"),
+)
+if _cache_dir != "0":
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

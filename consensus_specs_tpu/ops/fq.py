@@ -95,27 +95,53 @@ def _carry_limbs(t, out_limbs=NUM_LIMBS):
     return jnp.stack(outs[:out_limbs], axis=-1)
 
 
+def _shifted(vec, offset, total):
+    """Pad a (..., K)-limb vector to (..., total) at column `offset`
+    (static) — compiles to one concat, no scatter."""
+    k = vec.shape[-1]
+    pads = [(0, 0)] * (vec.ndim - 1) + [(offset, total - k - offset)]
+    return jnp.pad(vec, pads)
+
+
 def mont_mul(a, b):
-    """Montgomery product a*b*R^-1 (mod p); loose in, loose out."""
+    """Montgomery product a*b*R^-1 (mod p); loose in, loose out.
+
+    Vectorized SOS: the schoolbook product and each reduction step are
+    whole-vector ops (broadcast multiply + statically-padded shift + add) so
+    a call site is ~100 HLO ops — no scatters, XLA-compile-friendly.
+
+    Overflow audit (uint64 columns): schoolbook columns accumulate <= 15
+    products of loose limbs (< 2^28 each) => < 15*2^56 < 2^60; the reduction
+    adds one m*P_limb (< 2^56) per outer step per column plus single-limb
+    carries => total < 2^62."""
     a = jnp.asarray(a, jnp.uint64)
     b = jnp.asarray(b, jnp.uint64)
-    shape = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
-    t = jnp.zeros(shape + (NUM_LIMBS + 1,), dtype=jnp.uint64)
     n0 = jnp.uint64(N0)
     mask = jnp.uint64(MASK)
+    shift = jnp.uint64(LIMB_BITS)
+    total = 2 * NUM_LIMBS  # 30 columns (29 used; one spare)
+
+    # schoolbook columns: t[k] = sum_{i+j=k} a_i * b_j
+    t = None
     for i in range(NUM_LIMBS):
-        ai = a[..., i : i + 1]
-        t = t.at[..., :NUM_LIMBS].add(ai * b)
-        m = ((t[..., 0] & mask) * n0) & mask
-        t = t.at[..., :NUM_LIMBS].add(m[..., None] * _P_LIMBS_J)
-        # t[...,0] now divisible by 2^29; shift one limb down, carrying the
-        # high bits of t[...,0] into the new lowest limb
-        carry = t[..., 0] >> jnp.uint64(LIMB_BITS)
-        t = jnp.concatenate(
-            [t[..., 1:], jnp.zeros(shape + (1,), dtype=jnp.uint64)], axis=-1
+        row = a[..., i : i + 1] * b  # (..., 15)
+        t = _shifted(row, i, total) if t is None else t + _shifted(row, i, total)
+
+    # Montgomery reduction: clear limbs 0..14 low-to-high, propagating the
+    # single carry of each cleared limb
+    p_j = jnp.asarray(P_LIMBS, dtype=jnp.uint64)
+    for i in range(NUM_LIMBS):
+        ti = t[..., i]
+        m = ((ti & mask) * n0) & mask
+        add = m[..., None] * p_j  # (..., 15)
+        carry = (ti + m * p_j[0]) >> shift  # t[i] after add, divided by 2^28
+        # columns i+1..i+14 receive add[1:]; column i+1 also gets the carry
+        vec = jnp.concatenate(
+            [add[..., 1:2] + carry[..., None], add[..., 2:]], axis=-1
         )
-        t = t.at[..., 0].add(carry)
-    return _carry_limbs(t)
+        t = t + _shifted(vec, i + 1, total)
+
+    return _carry_limbs(t[..., NUM_LIMBS : 2 * NUM_LIMBS])
 
 
 def add(a, b):
@@ -196,6 +222,33 @@ def eq(a, b):
 
 def select(cond, a, b):
     return jnp.where(cond[..., None], a, b)
+
+
+def pow_fixed(a, exp_bits):
+    """a^e for a STATIC msb-first bit list `exp_bits`, branchless
+    square-and-multiply via lax.scan (loose in, loose out)."""
+    import jax
+
+    bits = jnp.asarray(exp_bits[1:], dtype=bool)  # MSB handled by init
+    batch = a.shape[:-1]
+
+    def body(acc, bit):
+        acc = mont_mul(acc, acc)
+        acc_mul = mont_mul(acc, a)
+        acc = jnp.where(jnp.broadcast_to(bit, batch)[..., None], acc_mul, acc)
+        return acc, None
+
+    acc, _ = jax.lax.scan(body, a, bits)
+    return acc
+
+
+_P_MINUS_2_BITS = [int(b) for b in bin(P - 2)[2:]]
+
+
+def inv(a):
+    """Modular inverse via Fermat: a^(p-2). inv(0) == 0 (used as the
+    infinity-absorbing property in Jacobian->affine conversion)."""
+    return pow_fixed(a, _P_MINUS_2_BITS)
 
 
 def zeros_like_batch(batch_shape):

@@ -125,11 +125,10 @@ def miller_loop(qx, qy, px, py):
     return tw.fq12_conjugate(f)
 
 
-def final_exp_is_one(f):
-    """f^((p^12-1)/r) == 1 via branchless square-and-multiply over the fixed
-    exponent bits. Returns bool (...,)."""
-    bits = jnp.asarray(_FINAL_EXP_BITS, dtype=bool)
-    acc = f  # MSB of the exponent is 1
+def _pow_fixed(f, bits_msb_first):
+    """f^e for a STATIC bit list, branchless square-and-multiply scan."""
+    bits = jnp.asarray(bits_msb_first[1:], dtype=bool)  # MSB absorbed by init
+    acc = f
 
     def body(acc, bit):
         acc = tw.fq12_square(acc)
@@ -138,7 +137,56 @@ def final_exp_is_one(f):
         return acc, None
 
     acc, _ = jax.lax.scan(body, acc, bits)
-    return tw.fq12_is_one(acc)
+    return acc
+
+
+def final_exp_is_one_full(f):
+    """Reference-slow path: f^((p^12-1)/r) == 1 by scanning the full ~4314-bit
+    exponent. Kept for cross-checking the structured version."""
+    return tw.fq12_is_one(_pow_fixed(f, [1] + _FINAL_EXP_BITS))
+
+
+_ABS_X_BITS = [int(b) for b in bin(-X_PARAM)[2:]]
+_ABS_X_PLUS_1_BITS = [int(b) for b in bin(-X_PARAM + 1)[2:]]
+
+
+def _unitary_pow_x(g):
+    """g^x for unitary g (x = BLS parameter, negative): conj(g^|x|)."""
+    return tw.fq12_conjugate(_pow_fixed(g, _ABS_X_BITS))
+
+
+def _unitary_pow_x_minus_1(g):
+    """g^(x-1) for unitary g: x-1 = -(|x|+1), so conj(g^(|x|+1))."""
+    return tw.fq12_conjugate(_pow_fixed(g, _ABS_X_PLUS_1_BITS))
+
+
+def final_exp_is_one(f):
+    """f^((p^12-1)/r) == 1, structured.
+
+    Easy part: g = f^((p^6-1)(p^2+1)) (one general Fq12 inversion; g lands in
+    the cyclotomic subgroup, where inverse == conjugate).
+    Hard part: Hayashida-Hayasaka-Teruya decomposition
+        3*(p^4-p^2+1)/r = (x-1)^2 * (x+p) * (x^2+p^2-1) + 3
+    (identity verified exactly in tests/test_ops_pairing.py). The extra
+    factor 3 is sound: f^E lies in the order-r subgroup and gcd(3, r) = 1,
+    so cubing is a bijection there and g^(3E') == 1 iff g^E' == 1.
+    Returns bool (...,).
+    """
+    # easy part
+    g = tw.fq12_mul(tw.fq12_conjugate(f), tw.fq12_inv(f))  # f^(p^6-1)
+    g = tw.fq12_mul(tw.fq12_frobenius(g, 2), g)  # ^(p^2+1)
+
+    # hard part: m = g^((x-1)^2)
+    t0 = _unitary_pow_x_minus_1(_unitary_pow_x_minus_1(g))
+    # ^(x+p)
+    t1 = tw.fq12_mul(_unitary_pow_x(t0), tw.fq12_frobenius(t0, 1))
+    # ^(x^2+p^2-1)
+    t2 = _unitary_pow_x(_unitary_pow_x(t1))
+    t2 = tw.fq12_mul(t2, tw.fq12_frobenius(t1, 2))
+    t2 = tw.fq12_mul(t2, tw.fq12_conjugate(t1))
+    # * g^3
+    res = tw.fq12_mul(t2, tw.fq12_mul(tw.fq12_square(g), g))
+    return tw.fq12_is_one(res)
 
 
 def pairing_product_is_one(pairs):

@@ -184,6 +184,147 @@ def fq12_from_tower_components(c00, c11w, c12w):
 
 
 # ---------------------------------------------------------------------------
+# flat <-> Fq2-component view (w^k coefficients, k = 0..5)
+#
+# In the flat basis w^6 = xi = 1 + u, so a flat element is
+#   sum_{k=0}^{5} (a_k + b_k u) w^k  with  a_k = flat[k] + flat[k+6],
+#                                          b_k = flat[k+6].
+# This view makes Frobenius and tower inversion expressible with fq2 ops.
+# ---------------------------------------------------------------------------
+
+
+def fq12_to_components(a):
+    """Flat (..., 12, L) -> list of 6 Fq2 coefficients (..., 2, L) for w^0..w^5."""
+    comps = []
+    for k in range(6):
+        lo, hi = a[..., k, :], a[..., k + 6, :]
+        comps.append(jnp.stack([fq.add(lo, hi), hi], axis=-2))
+    return comps
+
+
+def fq12_from_components(comps):
+    """Inverse of fq12_to_components."""
+    cols = []
+    for k in range(6):
+        a_, b_ = comps[k][..., 0, :], comps[k][..., 1, :]
+        cols.append(fq.sub(a_, b_))
+    for k in range(6):
+        cols.append(comps[k][..., 1, :])
+    return jnp.stack(cols, axis=-2)
+
+
+# Frobenius constants gamma[n][k] = xi^(k*(p^n-1)/6) as Fq2 ints (host once).
+def _fq2_pow_int(base, e: int):
+    acc = OFq2(1, 0)
+    b = base
+    while e:
+        if e & 1:
+            acc = acc * b
+        b = b * b
+        e >>= 1
+    return acc
+
+
+_XI = OFq2(1, 1)
+_GAMMA = {
+    n: [_fq2_pow_int(_XI, k * (P**n - 1) // 6) for k in range(6)] for n in (1, 2, 3)
+}
+
+
+def fq2_conjugate(a):
+    c0, c1 = a[..., 0, :], a[..., 1, :]
+    return jnp.stack([c0, fq.neg(c1)], axis=-2)
+
+
+def fq12_frobenius(a, n: int):
+    """a^(p^n) for n in {1, 2, 3}: conjugate Fq2 coefficients (n odd) and
+    scale the w^k coefficient by xi^(k*(p^n-1)/6)."""
+    comps = fq12_to_components(a)
+    batch = a.shape[:-2]
+    out = []
+    for k in range(6):
+        c = comps[k]
+        if n % 2 == 1:
+            c = fq2_conjugate(c)
+        g = _GAMMA[n][k]
+        if (g.c0, g.c1) != (1, 0):
+            c = fq2_mul(c, fq2_const(g.c0, g.c1, batch))
+        out.append(c)
+    return fq12_from_components(out)
+
+
+# ---------------------------------------------------------------------------
+# inversion: tower formulas over the component view
+# Fq6 = Fq2[v]/(v^3 - xi) with v = w^2; Fq12 = Fq6[w]/(w^2 - v).
+# Components: e0 = (c0, c2, c4) (even w-powers = 1, v, v^2),
+#             e1 = (c1, c3, c5) (odd  w-powers = w, vw, v^2 w).
+# ---------------------------------------------------------------------------
+
+
+def _fq2_mul_xi(a):
+    """Multiply by xi = 1 + u: (a0 + a1 u)(1 + u) = (a0 - a1) + (a0 + a1) u."""
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    return jnp.stack([fq.sub(a0, a1), fq.add(a0, a1)], axis=-2)
+
+
+def fq2_inv(a):
+    """(a0 + a1 u)^-1 = (a0 - a1 u) / (a0^2 + a1^2); inv(0) == 0."""
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    denom = fq.add(fq.mont_mul(a0, a0), fq.mont_mul(a1, a1))
+    di = fq.inv(denom)
+    return jnp.stack([fq.mont_mul(a0, di), fq.neg(fq.mont_mul(a1, di))], axis=-2)
+
+
+def _fq6_mul(a, b):
+    """Schoolbook Fq6 mul over component triples (tuples of Fq2)."""
+    a0, a1, a2 = a
+    b0, b1, b2 = b
+    t00 = fq2_mul(a0, b0)
+    t11 = fq2_mul(a1, b1)
+    t22 = fq2_mul(a2, b2)
+    c0 = fq2_add(t00, _fq2_mul_xi(fq2_add(fq2_mul(a1, b2), fq2_mul(a2, b1))))
+    c1 = fq2_add(fq2_add(fq2_mul(a0, b1), fq2_mul(a1, b0)), _fq2_mul_xi(t22))
+    c2 = fq2_add(fq2_add(fq2_mul(a0, b2), fq2_mul(a2, b0)), t11)
+    return (c0, c1, c2)
+
+
+def _fq6_mul_by_v(a):
+    a0, a1, a2 = a
+    return (_fq2_mul_xi(a2), a0, a1)
+
+
+def _fq6_inv(a):
+    a0, a1, a2 = a
+    A = fq2_sub(fq2_mul(a0, a0), _fq2_mul_xi(fq2_mul(a1, a2)))
+    B = fq2_sub(_fq2_mul_xi(fq2_mul(a2, a2)), fq2_mul(a0, a1))
+    C = fq2_sub(fq2_mul(a1, a1), fq2_mul(a0, a2))
+    F = fq2_add(fq2_mul(a0, A), _fq2_mul_xi(fq2_add(fq2_mul(a2, B), fq2_mul(a1, C))))
+    Fi = fq2_inv(F)
+    return (fq2_mul(A, Fi), fq2_mul(B, Fi), fq2_mul(C, Fi))
+
+
+def fq12_inv(a):
+    """General Fq12 inversion (flat in/out) via the 2-3-2 tower; one Fq
+    inversion (Fermat) total at the bottom."""
+    c = fq12_to_components(a)
+    e0 = (c[0], c[2], c[4])
+    e1 = (c[1], c[3], c[5])
+    d = tuple(
+        fq2_sub(x, y)
+        for x, y in zip(_fq6_mul(e0, e0), _fq6_mul_by_v(_fq6_mul(e1, e1)))
+    )
+    di = _fq6_inv(d)
+    o0 = _fq6_mul(e0, di)
+    o1 = tuple(fq2_neg(x) for x in _fq6_mul(e1, di))
+    comps = [o0[0], o1[0], o0[1], o1[1], o0[2], o1[2]]
+    return fq12_from_components(comps)
+
+
+def fq12_eq(a, b):
+    return jnp.all(fq.canonical(a) == fq.canonical(b), axis=(-1, -2))
+
+
+# ---------------------------------------------------------------------------
 # host conversions oracle tower <-> flat basis
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,375 @@
+"""SIMD field-ALU virtual machine: the TPU execution engine for BLS12-381.
+
+WHY A VM. XLA compile time is superlinear in graph size, so emitting a
+pairing (tens of thousands of field multiplies) as one traced graph cannot
+compile. Instead the device program is ONE `lax.scan` whose body is a fixed
+two-unit ALU:
+
+  - MUL unit: W_m lanes of batched Montgomery multiply (ops.fq.mont_mul)
+  - LIN unit: W_l lanes of add / borrowless-subtract (+ carry normalize)
+
+and the *schedule* — which registers each lane reads/writes at each step —
+is data (int32 arrays scanned over), assembled on host from a straight-line
+field program. Compile cost is therefore constant (~one mont_mul call site)
+no matter how long the pairing is; throughput comes from lane width x the
+leading batch dimension (N independent verifications), which is also the
+axis `shard_map` distributes over a TPU mesh.
+
+This mirrors how the reference splits semantics (Python) from the crypto
+hot loop (native milagro C, reference utils/bls.py:17-22): here the "native
+backend" is a field-ALU program compiled once by XLA.
+
+Register values are loose Montgomery residues (ops.fq conventions). The
+assembler tracks magnitude bounds per value and auto-inserts compress
+multiplies, so lazy reduction is handled statically at assembly time.
+"""
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import fq
+
+# value-magnitude bounds for lazy reduction. Limb-level uint64 overflow is
+# impossible by representation (limbs always < 2^28 after carry); these
+# bounds track VALUE magnitudes so results always fit the 15-limb capacity.
+_B_SUB_B = fq.MP  # subtrahend must not exceed the MP shift
+_B_SUB_A = 1 << 419  # minuend headroom: a + MP < 2^420
+_B_CAP = 1 << 420  # register capacity (15 x 28-bit limbs)
+
+_MUL, _ADD, _SUB = 0, 1, 2
+
+
+@dataclass
+class _Op:
+    kind: int  # _MUL/_ADD/_SUB
+    a: int  # producing op index (or register source)
+    b: int
+    bound: int
+    step: int = -1
+    reg: int = -1
+    last_use_step: int = -1
+
+
+class Val:
+    """Handle to a symbolic field value inside a Prog."""
+
+    __slots__ = ("prog", "idx")
+
+    def __init__(self, prog: "Prog", idx: int):
+        self.prog = prog
+        self.idx = idx
+
+    @property
+    def bound(self) -> int:
+        return self.prog.ops[self.idx].bound
+
+    # arithmetic sugar so formula code reads naturally
+    def __mul__(self, other: "Val") -> "Val":
+        return self.prog.mul(self, other)
+
+    def __add__(self, other: "Val") -> "Val":
+        return self.prog.add(self, other)
+
+    def __sub__(self, other: "Val") -> "Val":
+        return self.prog.sub(self, other)
+
+
+class Prog:
+    """Straight-line field-program builder with bound tracking."""
+
+    def __init__(self):
+        self.ops: List[_Op] = []
+        self.inputs: List[int] = []  # op indices with kind 'input'
+        self.input_names: List[str] = []
+        self.consts: Dict[int, int] = {}  # int value -> op idx
+        self.outputs: List[int] = []
+        self.output_names: List[str] = []
+        self._one: Optional[Val] = None
+        self._compressed: Dict[int, int] = {}  # op idx -> compressed op idx
+
+    # -- value creation ----------------------------------------------------
+
+    def _push(self, kind, a, b, bound) -> Val:
+        if bound >= _B_CAP:
+            raise AssertionError("assembler bound overflow — missing compress")
+        self.ops.append(_Op(kind, a, b, bound))
+        return Val(self, len(self.ops) - 1)
+
+    def inp(self, name: str) -> Val:
+        """Runtime input slot (canonical Montgomery residue, < p)."""
+        v = self._push(_MUL, -1, -1, fq.P)
+        self.ops[v.idx].kind = -1  # input marker
+        self.inputs.append(v.idx)
+        self.input_names.append(name)
+        return v
+
+    def const(self, value: int) -> Val:
+        """Compile-time field constant (plain integer mod p; encoded to
+        Montgomery form at program build)."""
+        value %= fq.P
+        if value in self.consts:
+            return Val(self, self.consts[value])
+        v = self._push(_MUL, -1, -1, fq.P)
+        self.ops[v.idx].kind = -2  # const marker
+        self.ops[v.idx].a = value  # stash the payload
+        self.consts[value] = v.idx
+        return v
+
+    # -- ALU ops -----------------------------------------------------------
+
+    def _raw_mul(self, a: Val, b: Val) -> Val:
+        out_bound = (a.bound * b.bound) // fq.R_MONT + fq.P + 1
+        return self._push(_MUL, a.idx, b.idx, out_bound)
+
+    def compress(self, v: Val) -> Val:
+        """Magnitude reduction: multiply by repr(1) (bound -> < 2^383);
+        memoized so repeated consumers share one compress."""
+        if v.idx in self._compressed:
+            return Val(self, self._compressed[v.idx])
+        if self._one is None or self._one.prog is not self:
+            self._one = self.const(1)
+        out = self._raw_mul(v, self._one)
+        self._compressed[v.idx] = out.idx
+        return out
+
+    def _fit(self, v: Val, bound: int) -> Val:
+        return self.compress(v) if v.bound > bound else v
+
+    def mul(self, a: Val, b: Val) -> Val:
+        while (a.bound * b.bound) // fq.R_MONT + fq.P + 1 >= _B_CAP:
+            if a.bound >= b.bound:
+                a = self.compress(a)
+            else:
+                b = self.compress(b)
+        return self._raw_mul(a, b)
+
+    def add(self, a: Val, b: Val) -> Val:
+        if a.bound + b.bound >= _B_CAP:
+            a = self.compress(a)
+            if a.bound + b.bound >= _B_CAP:
+                b = self.compress(b)
+        return self._push(_ADD, a.idx, b.idx, a.bound + b.bound)
+
+    def sub(self, a: Val, b: Val) -> Val:
+        a = self._fit(a, _B_SUB_A - fq.MP)
+        b = self._fit(b, _B_SUB_B)
+        return self._push(_SUB, a.idx, b.idx, a.bound + fq.MP)
+
+    def out(self, v: Val, name: str) -> None:
+        """Mark a value as a program output (compressed to < 2^382 so hosts
+        and epilogues get bounded limbs)."""
+        v = self.compress(v)
+        self.outputs.append(v.idx)
+        self.output_names.append(name)
+
+    # -- scheduling + register allocation ----------------------------------
+
+    def assemble(
+        self,
+        w_mul: int = 128,
+        w_lin: int = 128,
+        pad_steps_to: int = 1,
+        pad_regs_to: int = 1,
+    ) -> "Program":
+        """Schedule + allocate. `pad_steps_to`/`pad_regs_to` round the step
+        count and register-file size up to multiples/sizes so distinct
+        programs share XLA executables (compile cost is per shape bucket)."""
+        ops = self.ops
+        n = len(ops)
+        is_alu = [op.kind in (_MUL, _ADD, _SUB) for op in ops]
+
+        # 1) list-schedule ALU ops into steps
+        unit_of = [0 if op.kind == _MUL else 1 for op in ops]
+        width = (w_mul, w_lin)
+        fill: List[List[int]] = [[], []]  # per unit, per step lane count
+
+        for i, op in enumerate(ops):
+            if not is_alu[i]:
+                continue
+            earliest = 0
+            for src in (op.a, op.b):
+                s = ops[src].step
+                if s >= 0:
+                    earliest = max(earliest, s + 1)
+            u = unit_of[i]
+            t = earliest
+            f = fill[u]
+            while True:
+                while len(f) <= t:
+                    f.append(0)
+                if f[t] < width[u]:
+                    f[t] += 1
+                    op.step = t
+                    break
+                t += 1
+
+        n_steps = max(len(fill[0]), len(fill[1]))
+
+        # 2) liveness: last step at which each value is read
+        for i, op in enumerate(ops):
+            if not is_alu[i]:
+                continue
+            for src in (op.a, op.b):
+                ops[src].last_use_step = max(ops[src].last_use_step, op.step)
+        for idx in self.outputs:
+            ops[idx].last_use_step = n_steps + 1  # live to the end
+
+        # 3) linear-scan register allocation
+        #    reg 0 = always-zero scratch source for idle lanes
+        next_reg = 1
+        free: List[int] = []
+        # inputs and constants are defined "before step 0"
+        expiry: Dict[int, List[int]] = {}  # step -> regs to free after it
+
+        def alloc(op: _Op, def_step: int):
+            nonlocal next_reg
+            if free:
+                op.reg = free.pop()
+            else:
+                op.reg = next_reg
+                next_reg += 1
+            if op.last_use_step >= 0:
+                expiry.setdefault(op.last_use_step, []).append(op.reg)
+            else:
+                # value never used (dead code): free right away
+                expiry.setdefault(def_step, []).append(op.reg)
+
+        for i, op in enumerate(ops):
+            if op.kind in (-1, -2):
+                alloc(op, -1)
+        # walk steps in order, allocating defs and freeing after last use
+        by_step: Dict[int, List[int]] = {}
+        for i, op in enumerate(ops):
+            if is_alu[i]:
+                by_step.setdefault(op.step, []).append(i)
+        for t in range(n_steps):
+            for i in by_step.get(t, ()):
+                alloc(ops[i], t)
+            for r in expiry.get(t, ()):
+                free.append(r)
+
+        n_steps = -(-n_steps // pad_steps_to) * pad_steps_to
+        n_regs = next_reg
+        # trash registers for idle lanes
+        trash_mul = n_regs
+        trash_lin = n_regs + w_mul
+        n_regs += w_mul + w_lin
+        if n_regs < pad_regs_to:
+            n_regs = pad_regs_to
+
+        # 4) instruction arrays
+        msa = np.zeros((n_steps, w_mul), dtype=np.int32)
+        msb = np.zeros((n_steps, w_mul), dtype=np.int32)
+        msd = np.full((n_steps, w_mul), -1, dtype=np.int32)
+        lsa = np.zeros((n_steps, w_lin), dtype=np.int32)
+        lsb = np.zeros((n_steps, w_lin), dtype=np.int32)
+        lsub = np.zeros((n_steps, w_lin), dtype=bool)
+        lsd = np.full((n_steps, w_lin), -1, dtype=np.int32)
+        lane_ptr = [[0] * n_steps, [0] * n_steps]
+        for i, op in enumerate(ops):
+            if not is_alu[i]:
+                continue
+            t, u = op.step, unit_of[i]
+            lane = lane_ptr[u][t]
+            lane_ptr[u][t] = lane + 1
+            ra, rb = ops[op.a].reg, ops[op.b].reg
+            if u == 0:
+                msa[t, lane], msb[t, lane], msd[t, lane] = ra, rb, op.reg
+            else:
+                lsa[t, lane], lsb[t, lane], lsd[t, lane] = ra, rb, op.reg
+                lsub[t, lane] = op.kind == _SUB
+        # idle lanes -> trash registers (zero sources)
+        for t in range(n_steps):
+            for lane in range(lane_ptr[0][t], w_mul):
+                msd[t, lane] = trash_mul + lane
+            for lane in range(lane_ptr[1][t], w_lin):
+                lsd[t, lane] = trash_lin + lane
+
+        const_payload = {
+            op.reg: op.a for op in ops if op.kind == -2
+        }
+        input_regs = [ops[i].reg for i in self.inputs]
+        output_regs = [ops[i].reg for i in self.outputs]
+
+        return Program(
+            n_regs=n_regs,
+            instr=(msa, msb, msd, lsa, lsb, lsub, lsd),
+            input_regs=np.asarray(input_regs, dtype=np.int32),
+            input_names=list(self.input_names),
+            output_regs=np.asarray(output_regs, dtype=np.int32),
+            output_names=list(self.output_names),
+            const_regs=const_payload,
+            n_steps=n_steps,
+        )
+
+
+@dataclass
+class Program:
+    """Assembled VM program: static instruction tensors + register map."""
+
+    n_regs: int
+    instr: Tuple[np.ndarray, ...]
+    input_regs: np.ndarray
+    input_names: List[str]
+    output_regs: np.ndarray
+    output_names: List[str]
+    const_regs: Dict[int, int]  # reg -> plain int value
+    n_steps: int
+
+    def init_regs(self, batch_shape: Tuple[int, ...]) -> np.ndarray:
+        """Fresh register file with constants loaded (host-side numpy)."""
+        regs = np.zeros(batch_shape + (self.n_regs, fq.NUM_LIMBS), dtype=np.uint64)
+        for reg, value in self.const_regs.items():
+            regs[..., reg, :] = fq.to_mont_int(value)
+        return regs
+
+    def load_inputs(self, regs: np.ndarray, values: Dict[str, np.ndarray]) -> np.ndarray:
+        """Write named input limb arrays (batch-shaped, Montgomery form)."""
+        for name, reg in zip(self.input_names, self.input_regs):
+            regs[..., int(reg), :] = values[name]
+        return regs
+
+
+# MP + 1 in limb form: the additive shift of the borrowless subtract
+_MP_PLUS_1 = fq._int_to_limbs_np(fq.MP + 1)
+
+
+def _vm_step(regs, instr):
+    msa, msb, msd, lsa, lsb, lsub, lsd = instr
+    # MUL unit
+    a = jnp.take(regs, msa, axis=-2)
+    b = jnp.take(regs, msb, axis=-2)
+    m = fq.mont_mul(a, b)
+    # LIN unit: out = a + (is_sub ? (MP+1) + (MASK - b) : b), carried
+    la = jnp.take(regs, lsa, axis=-2)
+    lb = jnp.take(regs, lsb, axis=-2)
+    comp = jnp.asarray(_MP_PLUS_1) + (jnp.uint64(fq.MASK) - lb)
+    rhs = jnp.where(lsub[..., None], comp, lb)
+    lin = fq._carry_limbs(la + rhs, out_limbs=fq.NUM_LIMBS + 1)[..., : fq.NUM_LIMBS]
+    regs = regs.at[..., msd, :].set(m)
+    regs = regs.at[..., lsd, :].set(lin)
+    return regs, None
+
+
+@jax.jit
+def _vm_run(regs, instr_arrays):
+    regs, _ = jax.lax.scan(_vm_step, regs, instr_arrays)
+    return regs
+
+
+def execute(program: Program, inputs: Dict[str, np.ndarray], batch_shape=()) -> Dict[str, np.ndarray]:
+    """Run an assembled program. Input arrays must be Montgomery limb arrays
+    of shape batch_shape + (NUM_LIMBS,). Returns named outputs (loose,
+    bounded < 2^382)."""
+    regs = program.init_regs(tuple(batch_shape))
+    regs = program.load_inputs(regs, inputs)
+    instr = tuple(jnp.asarray(x) for x in program.instr)
+    out = _vm_run(jnp.asarray(regs), instr)
+    out = np.asarray(out)
+    return {
+        name: out[..., int(reg), :]
+        for name, reg in zip(program.output_names, program.output_regs)
+    }

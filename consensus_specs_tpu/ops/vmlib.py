@@ -1,0 +1,522 @@
+"""BLS12-381 pairing pipeline expressed as field-ALU VM programs.
+
+Builds the straight-line programs the VM (ops.vm) schedules onto the device:
+
+- PROG A `miller_product(K)`: tree-reduce K projective G1 pubkey points
+  (Renes-Costello-Batina complete additions — branchless, infinity-safe, so
+  masked committee lanes are just infinity inputs), then run both Miller
+  loops of the verification equation
+      e(agg_pk, H(m)) * e(-g1, sig)
+  with the aggregate consumed PROJECTIVELY (line functions scaled by the
+  subfield factors Z_P/X_P/Y_P, which the final exponentiation kills — no
+  inversion anywhere on device). Outputs the paired f in Fq12 and the
+  aggregate's Z (host checks infinity).
+
+- PROG B `hard_part`: the Hayashida-Hayasaka-Teruya hard part of the final
+  exponentiation on a unitary g, using Granger-Scott cyclotomic squarings:
+      3*(p^4-p^2+1)/r = (x-1)^2 * (x+p) * (x^2+p^2-1) + 3
+  (exact-integer identity asserted below; the factor 3 is sound because f^E
+  lies in the order-r subgroup and gcd(3, r) = 1).
+
+The easy part (one Fq12 inversion + two Frobenius/multiplies) runs on HOST
+with exact integers between the two programs — inversion is the only
+data-dependent-depth operation and is a few microseconds in Python, while
+on device it would serialize ~570 scan steps.
+
+Ate-loop and exponent bit patterns are STATIC, so conditional Miller adds
+exist only at the 6 set bits of the BLS parameter — no runtime selects.
+
+All formulas are cross-checked against the pure-Python oracle
+(tests/test_vm.py); the reference's equivalent backend is the milagro C
+binding (reference utils/bls.py:17-22).
+"""
+from typing import List, Sequence, Tuple
+
+from ..utils.bls12_381 import P, X_PARAM
+from .vm import Prog, Val
+
+# BLS parameter bit patterns (static schedules)
+ATE_BITS = [int(b) for b in bin(-X_PARAM)[2:]]  # MSB-first
+ABS_X_BITS = ATE_BITS
+ABS_X_PLUS_1_BITS = [int(b) for b in bin(-X_PARAM + 1)[2:]]
+
+# HHT hard-part identity (exact check at import)
+_R_ORDER = 0x73EDA753299D7D483339D80809A1D80553BDA402FFFE5BFEFFFFFFFF00000001
+assert 3 * ((P**4 - P**2 + 1) // _R_ORDER) == (X_PARAM - 1) ** 2 * (
+    X_PARAM + P
+) * (X_PARAM**2 + P**2 - 1) + 3
+
+# Frobenius gamma constants: frob^n(w^k) = xi^(k*(p^n-1)/6) * w^k, xi = 1+u
+def _fq2_mul_int(a, b):
+    a0, a1 = a
+    b0, b1 = b
+    return ((a0 * b0 - a1 * b1) % P, (a0 * b1 + a1 * b0) % P)
+
+
+def _fq2_pow_int(base, e: int):
+    acc = (1, 0)
+    while e:
+        if e & 1:
+            acc = _fq2_mul_int(acc, base)
+        base = _fq2_mul_int(base, base)
+        e >>= 1
+    return acc
+
+
+GAMMA = {
+    n: [_fq2_pow_int((1, 1), k * (P**n - 1) // 6) for k in range(6)]
+    for n in (1, 2, 3)
+}
+
+
+class F2:
+    """Fq2 element of two symbolic Vals (c0 + c1*u, u^2 = -1)."""
+
+    __slots__ = ("c0", "c1")
+
+    def __init__(self, c0: Val, c1: Val):
+        self.c0 = c0
+        self.c1 = c1
+
+    @property
+    def prog(self) -> Prog:
+        return self.c0.prog
+
+    def __add__(self, o: "F2") -> "F2":
+        return F2(self.c0 + o.c0, self.c1 + o.c1)
+
+    def __sub__(self, o: "F2") -> "F2":
+        return F2(self.c0 - o.c0, self.c1 - o.c1)
+
+    def __mul__(self, o: "F2") -> "F2":
+        t0 = self.c0 * o.c0
+        t1 = self.c1 * o.c1
+        t2 = (self.c0 + self.c1) * (o.c0 + o.c1)
+        return F2(t0 - t1, t2 - (t0 + t1))
+
+    def square(self) -> "F2":
+        c0 = (self.c0 + self.c1) * (self.c0 - self.c1)
+        m = self.c0 * self.c1
+        return F2(c0, m + m)
+
+    def double(self) -> "F2":
+        return F2(self.c0 + self.c0, self.c1 + self.c1)
+
+    def neg(self) -> "F2":
+        z = self.prog.const(0)
+        return F2(z - self.c0, z - self.c1)
+
+    def conj(self) -> "F2":
+        z = self.prog.const(0)
+        return F2(self.c0, z - self.c1)
+
+    def mul_xi(self) -> "F2":
+        """* (1 + u)."""
+        return F2(self.c0 - self.c1, self.c0 + self.c1)
+
+    def scale(self, s: Val) -> "F2":
+        return F2(self.c0 * s, self.c1 * s)
+
+    def mul_const(self, c: Tuple[int, int]) -> "F2":
+        p = self.prog
+        if c == (1, 0):
+            return self
+        if c[1] == 0:
+            k = p.const(c[0])
+            return F2(self.c0 * k, self.c1 * k)
+        if c[0] == 0:
+            k = p.const(c[1])
+            # (c0 + c1 u) * k u = -c1 k + c0 k u
+            z = p.const(0)
+            return F2(z - (self.c1 * k), self.c0 * k)
+        return self * F2(p.const(c[0]), p.const(c[1]))
+
+
+def f2_inputs(prog: Prog, name: str) -> F2:
+    return F2(prog.inp(name + ".0"), prog.inp(name + ".1"))
+
+
+def f2_const(prog: Prog, c0: int, c1: int) -> F2:
+    return F2(prog.const(c0), prog.const(c1))
+
+
+# ---------------------------------------------------------------------------
+# Fq12 flat basis (12 Vals, w-powers; w^12 - 2 w^6 + 2 = 0, w^6 = 1 + u)
+# ---------------------------------------------------------------------------
+
+_CONV_IDX = [[(i, k - i) for i in range(12) if 0 <= k - i < 12] for k in range(23)]
+
+
+def _reduce_cols(prog: Prog, cols: List[Val]) -> List[Val]:
+    """Fold degrees 22..12 down with w^12 = 2w^6 - 2."""
+    for k in range(22, 11, -1):
+        c = cols[k]
+        if c is None:
+            continue
+        c2 = c + c
+        cols[k - 6] = c2 if cols[k - 6] is None else cols[k - 6] + c2
+        cols[k - 12] = (
+            prog.const(0) - c2 if cols[k - 12] is None else cols[k - 12] - c2
+        )
+    return cols[:12]
+
+
+def _sum(vals: List[Val]) -> Val:
+    acc = vals[0]
+    for v in vals[1:]:
+        acc = acc + v
+    return acc
+
+
+def f12_mul(prog: Prog, a: List[Val], b: List[Val]) -> List[Val]:
+    prods = {}
+    for i in range(12):
+        for j in range(12):
+            prods[(i, j)] = a[i] * b[j]
+    cols: List[Val] = [None] * 23
+    for k in range(23):
+        cols[k] = _sum([prods[ij] for ij in _CONV_IDX[k]])
+    return _reduce_cols(prog, cols)
+
+
+def f12_square(prog: Prog, a: List[Val]) -> List[Val]:
+    """Symmetric products: 78 muls instead of 144."""
+    cols: List[Val] = [None] * 23
+    for i in range(12):
+        for j in range(i, 12):
+            p = a[i] * a[j]
+            if i != j:
+                p = p + p
+            k = i + j
+            cols[k] = p if cols[k] is None else cols[k] + p
+    return _reduce_cols(prog, cols)
+
+
+def f12_conj(prog: Prog, a: List[Val]) -> List[Val]:
+    """x -> x^(p^6): negate odd w-powers."""
+    z = prog.const(0)
+    return [a[k] if k % 2 == 0 else z - a[k] for k in range(12)]
+
+
+def f12_one(prog: Prog) -> List[Val]:
+    one = prog.const(1)
+    z = prog.const(0)
+    return [one] + [z] * 11
+
+
+# component view: c_k (Fq2) at w^k for k = 0..5;
+# flat[k] = a_k - b_k, flat[k+6] = b_k  (since u = w^6 - 1)
+
+
+def f12_to_comps(a: List[Val]) -> List[F2]:
+    return [F2(a[k] + a[k + 6], a[k + 6]) for k in range(6)]
+
+
+def f12_from_comps(comps: Sequence[F2]) -> List[Val]:
+    return [comps[k].c0 - comps[k].c1 for k in range(6)] + [
+        comps[k].c1 for k in range(6)
+    ]
+
+
+def f12_frobenius(prog: Prog, a: List[Val], n: int) -> List[Val]:
+    comps = f12_to_comps(a)
+    out = []
+    for k in range(6):
+        c = comps[k]
+        if n % 2 == 1:
+            c = c.conj()
+        out.append(c.mul_const(GAMMA[n][k]))
+    return f12_from_comps(out)
+
+
+def f12_cyclotomic_square(prog: Prog, a: List[Val]) -> List[Val]:
+    """Granger-Scott squaring for unitary elements of the cyclotomic
+    subgroup (9 Fq2 squarings). Component slots (tower naming):
+    C0.B0=w^0, C0.B1=w^2, C0.B2=w^4, C1.B0=w^1, C1.B1=w^3, C1.B2=w^5."""
+    c = f12_to_comps(a)
+    c0b0, c1b0, c0b1, c1b1, c0b2, c1b2 = c[0], c[1], c[2], c[3], c[4], c[5]
+
+    t0 = c1b1.square()
+    t1 = c0b0.square()
+    t6 = (c1b1 + c0b0).square() - t0 - t1  # 2*c0b0*c1b1
+    t2 = c0b2.square()
+    t3 = c1b0.square()
+    t7 = (c0b2 + c1b0).square() - t2 - t3  # 2*c0b2*c1b0
+    t4 = c1b2.square()
+    t5 = c0b1.square()
+    t8 = ((c1b2 + c0b1).square() - t4 - t5).mul_xi()  # 2*xi*c0b1*c1b2
+
+    t0 = t0.mul_xi() + t1  # c0b0^2 + xi*c1b1^2
+    t2 = t2.mul_xi() + t3  # c1b0^2 + xi*c0b2^2
+    t4 = t4.mul_xi() + t5  # c0b1^2 + xi*c1b2^2
+
+    z0 = (t0 - c0b0).double() + t0
+    z1 = (t2 - c0b1).double() + t2
+    z2 = (t4 - c0b2).double() + t4
+    z3 = (t8 + c1b0).double() + t8
+    z4 = (t6 + c1b1).double() + t6
+    z5 = (t7 + c1b2).double() + t7
+    return f12_from_comps([z0, z3, z1, z4, z2, z5])
+
+
+def f12_unitary_pow_abs(prog: Prog, g: List[Val], bits: Sequence[int]) -> List[Val]:
+    """g^e for a STATIC msb-first bit string, cyclotomic squarings + dense
+    multiplies at set bits. g must be unitary."""
+    acc = g
+    for bit in bits[1:]:
+        acc = f12_cyclotomic_square(prog, acc)
+        if bit:
+            acc = f12_mul(prog, acc, g)
+    return acc
+
+
+def f12_pow_x(prog: Prog, g: List[Val]) -> List[Val]:
+    """g^x, x the (negative) BLS parameter; unitary g."""
+    return f12_conj(prog, f12_unitary_pow_abs(prog, g, ABS_X_BITS))
+
+
+def f12_pow_x_minus_1(prog: Prog, g: List[Val]) -> List[Val]:
+    """g^(x-1) = conj(g^(|x|+1)); unitary g."""
+    return f12_conj(prog, f12_unitary_pow_abs(prog, g, ABS_X_PLUS_1_BITS))
+
+
+# ---------------------------------------------------------------------------
+# G1: Renes-Costello-Batina complete addition (projective, a=0, b=4, b3=12)
+# ---------------------------------------------------------------------------
+
+
+def g1_complete_add(prog: Prog, p1, p2):
+    """(X3:Y3:Z3) = P1 + P2, complete (handles doubling and infinity).
+    RCB 2016 algorithm 7 for y^2 = x^3 + 4; b3 = 12."""
+    X1, Y1, Z1 = p1
+    X2, Y2, Z2 = p2
+    b3 = prog.const(12)
+
+    t0 = X1 * X2
+    t1 = Y1 * Y2
+    t2 = Z1 * Z2
+    t3 = (X1 + Y1) * (X2 + Y2)
+    t3 = t3 - (t0 + t1)  # X1Y2 + X2Y1
+    t4 = (Y1 + Z1) * (Y2 + Z2)
+    t4 = t4 - (t1 + t2)  # Y1Z2 + Y2Z1
+    X3 = (X1 + Z1) * (X2 + Z2)
+    Y3 = X3 - (t0 + t2)  # X1Z2 + X2Z1
+    X3 = t0 + t0
+    t0 = X3 + t0  # 3 X1X2
+    t2 = b3 * t2
+    Z3 = t1 + t2
+    t1 = t1 - t2
+    Y3 = b3 * Y3
+    X3 = t4 * Y3
+    t2 = t3 * t1
+    X3 = t2 - X3
+    Y3 = Y3 * t0
+    t1 = t1 * Z3
+    Y3 = t1 + Y3
+    t0 = t0 * t3
+    Z3 = Z3 * t4
+    Z3 = Z3 + t0
+    return (X3, Y3, Z3)
+
+
+def g1_tree_sum(prog: Prog, points):
+    """Pairwise tree reduction of projective points (log2 depth)."""
+    while len(points) > 1:
+        nxt = []
+        for i in range(0, len(points) - 1, 2):
+            nxt.append(g1_complete_add(prog, points[i], points[i + 1]))
+        if len(points) % 2:
+            nxt.append(points[-1])
+        points = nxt
+    return points[0]
+
+
+# ---------------------------------------------------------------------------
+# Miller loop (T Jacobian on the twist; P projective G1)
+# ---------------------------------------------------------------------------
+
+
+def _line_to_flat(c_1: F2, c_vw: F2, c_v2w: F2) -> dict:
+    """Sparse line: tower slots 1 (w^0), v*w (w^3), v^2*w (w^5)."""
+    return {0: c_1, 3: c_vw, 5: c_v2w}
+
+
+def f12_mul_sparse(prog: Prog, a: List[Val], line: dict) -> List[Val]:
+    """a * line where line has Fq2 components at w-powers {0, 3, 5}:
+    flat coeffs at k: c0-c1, at k+6: c1 — i.e. 6 nonzero flat coeffs."""
+    flat = {}
+    for k, f2 in line.items():
+        flat[k] = f2.c0 - f2.c1
+        flat[k + 6] = f2.c1
+    cols: List[Val] = [None] * 23
+    for j, lj in flat.items():
+        for i in range(12):
+            p = a[i] * lj
+            k = i + j
+            cols[k] = p if cols[k] is None else cols[k] + p
+    # fill any untouched columns (cannot happen here, but keep safe)
+    z = None
+    for k in range(12):
+        if cols[k] is None:
+            z = z or prog.const(0)
+            cols[k] = z
+    return _reduce_cols(prog, cols)
+
+
+def _dbl_step(prog: Prog, T, Pxyz):
+    """Double T, return (line, 2T); line scaled by the projective P factors."""
+    X, Y, Z = T
+    XP, YP, ZP = Pxyz
+    X2 = X.square()
+    A3 = X2 + X2 + X2  # 3X^2
+    Y2 = Y.square()
+    Z2 = Z.square()
+    YZ = Y * Z
+    YZ3 = YZ * Z2  # Y*Z^3
+    two_YZ3 = YZ3 + YZ3
+
+    c_1 = two_YZ3.mul_xi().neg().scale(YP)
+    c_v2w = (A3 * Z2).scale(XP)
+    c_vw = (Y2 + Y2 - A3 * X).scale(ZP)
+    line = _line_to_flat(c_1, c_vw, c_v2w)
+
+    # Jacobian doubling (a = 0), sharing X2/Y2/YZ
+    C = Y2.square()
+    t = (X + Y2).square() - X2 - C
+    D = t + t
+    F = A3.square()
+    X3 = F - (D + D)
+    C8 = C.double().double().double()
+    Y3 = A3 * (D - X3) - C8
+    Z3n = YZ + YZ
+    return line, (X3, Y3, Z3n)
+
+
+def _add_step(prog: Prog, T, Q, Pxyz):
+    """T + Q (Q affine), with the line through them, scaled by projective P."""
+    X, Y, Z = T
+    qx, qy = Q
+    XP, YP, ZP = Pxyz
+    Z2 = Z.square()
+    Z3 = Z2 * Z
+    U2 = qx * Z2
+    S2 = qy * Z3
+    H = U2 - X
+    Rr = S2 - Y
+    HZ = H * Z
+
+    c_1 = HZ.mul_xi().neg().scale(YP)
+    c_v2w = Rr.scale(XP)
+    c_vw = (qy * HZ - Rr * qx).scale(ZP)
+    line = _line_to_flat(c_1, c_vw, c_v2w)
+
+    H2 = H.square()
+    H3 = H2 * H
+    V = X * H2
+    R2 = Rr.square()
+    X3 = R2 - H3 - (V + V)
+    Y3 = Rr * (V - X3) - Y * H3
+    return line, (X3, Y3, HZ)
+
+
+def miller_loop(prog: Prog, Q, Pxyz) -> List[Val]:
+    """f_{|x|}(Q, P) with the negative-x conjugation. Q = (qx, qy) affine F2
+    pairs on the twist; Pxyz = projective G1 Vals. Static ate bit schedule —
+    add-steps only at set bits."""
+    qx, qy = Q
+    one = f2_const(prog, 1, 0)
+    T = (qx, qy, one)
+    f = None  # lazily 1; first square is a no-op
+
+    for bit in ATE_BITS[1:]:
+        if f is not None:
+            f = f12_square(prog, f)
+        line, T = _dbl_step(prog, T, Pxyz)
+        if f is None:
+            f = f12_from_comps(
+                [line.get(k, f2_const(prog, 0, 0)) for k in range(6)]
+            )
+        else:
+            f = f12_mul_sparse(prog, f, line)
+        if bit:
+            line, T = _add_step(prog, T, Q, Pxyz)
+            f = f12_mul_sparse(prog, f, line)
+    return f12_conj(prog, f)
+
+
+# ---------------------------------------------------------------------------
+# program builders
+# ---------------------------------------------------------------------------
+
+# affine -(G1 generator)
+_G1_X = 0x17F1D3A73197D7942695638C4FA9AC0FC3688C4F9774B905A14E3A3F171BAC586C55E83FF97A1AEFFB3AF00ADB22C6BB
+_G1_Y = 0x08B3F481E3AAA0F1A09E30ED741D8AE4FCF5E095D5D00AF600DB18CB2C04B3EDD03CC744A2888AE40CAA232946C5E7E1
+
+
+def build_miller_product(k_pubkeys: int) -> Prog:
+    """PROG A: aggregate K projective pubkeys + both Miller loops.
+
+    Inputs: pk{j}.{x,y,z} (projective G1; infinity = (0,1,0) for masked
+    lanes), h.{x,y}.{0,1} (H(m) on the twist, affine Fq2), sig.{x,y}.{0,1}.
+    Outputs: f.0..f.11 (Fq12, pre-final-exp), aggz (aggregate Z)."""
+    prog = Prog()
+    pts = [
+        (prog.inp(f"pk{j}.x"), prog.inp(f"pk{j}.y"), prog.inp(f"pk{j}.z"))
+        for j in range(k_pubkeys)
+    ]
+    hx = f2_inputs(prog, "h.x")
+    hy = f2_inputs(prog, "h.y")
+    sx = f2_inputs(prog, "sig.x")
+    sy = f2_inputs(prog, "sig.y")
+
+    agg = g1_tree_sum(prog, pts) if k_pubkeys > 1 else pts[0]
+
+    f1 = miller_loop(prog, (hx, hy), agg)
+    ng = (prog.const(_G1_X), prog.const((-_G1_Y) % P), prog.const(1))
+    f2_ = miller_loop(prog, (sx, sy), ng)
+    f = f12_mul(prog, f1, f2_)
+    for i in range(12):
+        prog.out(f[i], f"f.{i}")
+    prog.out(agg[2], "aggz")
+    return prog
+
+
+def build_aggregate_verify_miller(k_pairs: int) -> Prog:
+    """PROG A variant for AggregateVerify: prod_i e(pk_i, H(m_i)) * e(-g1, sig).
+    Pubkeys PROJECTIVE so inactive lanes can pass infinity (0:1:0), whose
+    Miller factor lands in a proper subfield and is killed by the final
+    exponentiation."""
+    prog = Prog()
+    one = prog.const(1)
+    f = None
+    for j in range(k_pairs):
+        pxyz = (prog.inp(f"pk{j}.x"), prog.inp(f"pk{j}.y"), prog.inp(f"pk{j}.z"))
+        hx = f2_inputs(prog, f"h{j}.x")
+        hy = f2_inputs(prog, f"h{j}.y")
+        fj = miller_loop(prog, (hx, hy), pxyz)
+        f = fj if f is None else f12_mul(prog, f, fj)
+    sx = f2_inputs(prog, "sig.x")
+    sy = f2_inputs(prog, "sig.y")
+    ng = (prog.const(_G1_X), prog.const((-_G1_Y) % P), one)
+    f2_ = miller_loop(prog, (sx, sy), ng)
+    f = f12_mul(prog, f, f2_)
+    for i in range(12):
+        prog.out(f[i], f"f.{i}")
+    return prog
+
+
+def build_hard_part() -> Prog:
+    """PROG B: HHT hard part on unitary g (12 inputs), outputs res (12).
+    res == 1 iff g^((p^4-p^2+1)/r) == 1."""
+    prog = Prog()
+    g = [prog.inp(f"g.{i}") for i in range(12)]
+
+    t0 = f12_pow_x_minus_1(prog, f12_pow_x_minus_1(prog, g))  # g^((x-1)^2)
+    t1 = f12_mul(prog, f12_pow_x(prog, t0), f12_frobenius(prog, t0, 1))
+    t2 = f12_pow_x(prog, f12_pow_x(prog, t1))
+    t2 = f12_mul(prog, t2, f12_frobenius(prog, t1, 2))
+    t2 = f12_mul(prog, t2, f12_conj(prog, t1))
+    res = f12_mul(prog, t2, f12_mul(prog, f12_square(prog, g), g))
+    for i in range(12):
+        prog.out(res[i], f"res.{i}")
+    return prog

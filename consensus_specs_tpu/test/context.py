@@ -218,25 +218,26 @@ def bls_switch(fn):
 
 
 def always_bls(fn):
-    """Force BLS on for this test; place ABOVE @spec_state_test
-    (reference context.py:273-283)."""
+    """Force BLS on for this test via an inner bls_switch — the override is
+    beyond the reach of the outer switch (reference context.py:285-296)."""
 
     @_wraps(fn)
     def entry(*args, **kw):
         kw["bls_active"] = True
-        return _invoke(fn, kw)
+        return bls_switch(fn)(*args, **kw)
 
     entry.bls_setting = 1
     return entry
 
 
 def never_bls(fn):
-    """Force BLS off for this test (reference context.py:286-296)."""
+    """Force BLS off for this test via an inner bls_switch
+    (reference context.py:272-283)."""
 
     @_wraps(fn)
     def entry(*args, **kw):
         kw["bls_active"] = False
-        return _invoke(fn, kw)
+        return bls_switch(fn)(*args, **kw)
 
     entry.bls_setting = 2
     return entry

@@ -169,6 +169,7 @@ def test_bad_merkle_proof(spec, state):
 
 @with_all_phases
 @spec_state_test
+@always_bls
 def test_key_validate_invalid_subgroup(spec, state):
     validator_index = len(state.validators)
     amount = spec.MAX_EFFECTIVE_BALANCE

@@ -5,7 +5,7 @@ from ...context import (
     with_custom_state, zero_activation_threshold, default_activation_threshold,
 )
 from ...helpers.epoch_processing import run_epoch_processing_with
-from ...helpers.state import next_epoch
+from ...helpers.state import next_epoch, next_slots
 
 
 def mock_deposit(spec, state, index):
@@ -105,8 +105,9 @@ def test_activation_queue_sorting(spec, state):
     # give the last priority over the others
     state.validators[mock_activations - 1].activation_eligibility_epoch = epoch
 
-    # make sure we are hitting the churn
-    assert mock_activations > churn_limit
+    # move state forward and finalize so the queued entries become eligible
+    next_slots(spec, state, spec.SLOTS_PER_EPOCH * 3)
+    state.finalized_checkpoint.epoch = epoch + 1
 
     yield from run_process_registry_updates(spec, state)
 
@@ -117,10 +118,10 @@ def test_activation_queue_sorting(spec, state):
     # the second last is at the end of the queue, and did not make the churn,
     #  hence it is not assigned an activation_epoch yet.
     assert state.validators[mock_activations - 2].activation_epoch == spec.FAR_FUTURE_EPOCH
-    # the one at churn_limit - 1 did not make it, it was out-prioritized
-    assert state.validators[churn_limit - 1].activation_epoch == spec.FAR_FUTURE_EPOCH
+    # the one at churn_limit did not make it, it was out-prioritized
+    assert state.validators[churn_limit].activation_epoch == spec.FAR_FUTURE_EPOCH
     # but the one in front of the above did
-    assert state.validators[churn_limit - 2].activation_epoch != spec.FAR_FUTURE_EPOCH
+    assert state.validators[churn_limit - 1].activation_epoch != spec.FAR_FUTURE_EPOCH
 
 
 @with_all_phases
@@ -134,17 +135,29 @@ def test_activation_queue_efficiency_min(spec, state):
         mock_deposit(spec, state, i)
         state.validators[i].activation_eligibility_epoch = epoch + 1
 
+    # move state forward and finalize so the queued entries become eligible
+    next_slots(spec, state, spec.SLOTS_PER_EPOCH * 3)
     state.finalized_checkpoint.epoch = epoch + 1
 
-    # Run first intermediate epoch transition
-    yield from run_process_registry_updates(spec, state)
+    # Churn limit may have shifted since mock_deposit deactivated validators
+    churn_limit_0 = spec.get_validator_churn_limit(state)
+
+    # Run first registry update without yielding vectors
+    for _ in run_process_registry_updates(spec, state):
+        pass
 
     # Half should churn in first run of registry update
     for i in range(mock_activations):
-        if i < churn_limit:
+        if i < churn_limit_0:
             assert state.validators[i].activation_epoch < spec.FAR_FUTURE_EPOCH
         else:
             assert state.validators[i].activation_epoch == spec.FAR_FUTURE_EPOCH
+
+    # Second half should churn in second run of registry update
+    churn_limit_1 = spec.get_validator_churn_limit(state)
+    yield from run_process_registry_updates(spec, state)
+    for i in range(churn_limit_0 + churn_limit_1):
+        assert state.validators[i].activation_epoch < spec.FAR_FUTURE_EPOCH
 
 
 @with_all_phases

@@ -150,6 +150,10 @@ def AggregateVerify(pubkeys: Sequence[bytes], messages: Sequence[bytes], signatu
     try:
         if len(pubkeys) == 0 or len(pubkeys) != len(messages):
             return False
+        if _backend == "tpu":
+            from ..ops import bls_backend as tpu_backend
+
+            return tpu_backend.aggregate_verify(pubkeys, messages, signature)
         sig_aff = _sig_to_checked_point(signature)
         pairs = []
         for pk, msg in zip(pubkeys, messages):

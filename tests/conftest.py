@@ -25,13 +25,38 @@ def pytest_addoption(parser):
         help="fork(s) to run tests against (repeatable)",
     )
     parser.addoption(
-        "--disable-bls", action="store_true", default=False,
-        help="disable BLS for tests that do not require it",
+        "--disable-bls", action="store_true", default=True,
+        help="disable BLS for tests that do not require it (the default, "
+        "mirroring the reference's `make test`, reference Makefile:100; "
+        "@always_bls tests still run real BLS)",
+    )
+    parser.addoption(
+        "--enable-bls", action="store_true", default=False,
+        help="run every test with real BLS (reference `make citest` mode)",
+    )
+    parser.addoption(
+        "--run-slow", action="store_true", default=False,
+        help="run tests marked slow (long XLA compiles / big batches)",
     )
     parser.addoption(
         "--bls-type", action="store", type=str, default="py_ecc",
         help="BLS backend: py_ecc (pure-python oracle) or tpu (JAX backend)",
     )
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long XLA compiles / large batches; needs --run-slow"
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    if config.getoption("--run-slow"):
+        return
+    skip_slow = pytest.mark.skip(reason="slow test: pass --run-slow to run")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip_slow)
 
 
 @pytest.fixture(autouse=True)
@@ -42,8 +67,9 @@ def _configure_harness(request):
     context.DEFAULT_TEST_PRESET = request.config.getoption("--preset")
     forks = request.config.getoption("--fork")
     context.DEFAULT_PYTEST_FORKS = set(forks) if forks else None
-    if request.config.getoption("--disable-bls"):
-        bls.bls_active = False
+    # default: BLS off except @always_bls (reference `make test`,
+    # Makefile:100); --enable-bls mirrors `make citest` (Makefile:111)
+    context.DEFAULT_BLS_ACTIVE = bool(request.config.getoption("--enable-bls"))
     bls_type = request.config.getoption("--bls-type")
     if bls_type == "tpu":
         bls.use_tpu()

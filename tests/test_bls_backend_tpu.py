@@ -1,0 +1,98 @@
+"""Cross-check the JAX/TPU BLS backend against the pure-Python oracle —
+the reference's py_ecc-vs-milagro cross-check pattern
+(reference: tests/generators/bls/main.py:80, 108-114) applied to the new
+backend."""
+import pytest
+
+from consensus_specs_tpu.utils import bls
+
+# whole-pairing device programs: long XLA compiles on the CPU backend
+pytestmark = pytest.mark.slow
+
+
+PRIVKEYS = [i + 1 for i in range(8)]
+PUBKEYS = [bls.SkToPk(sk) for sk in PRIVKEYS]
+MESSAGES = [bytes([i]) * 32 for i in range(4)]
+
+
+@pytest.fixture(autouse=True)
+def _restore_backend():
+    yield
+    bls.use_py_ecc()
+
+
+def test_verify_matches_oracle():
+    from consensus_specs_tpu.ops import bls_backend
+
+    msg = MESSAGES[0]
+    sig = bls.Sign(PRIVKEYS[0], msg)
+    assert bls_backend.verify(PUBKEYS[0], msg, sig) is True
+    # wrong message
+    assert bls_backend.verify(PUBKEYS[0], MESSAGES[1], sig) is False
+    # wrong key
+    assert bls_backend.verify(PUBKEYS[1], msg, sig) is False
+    # garbage signature encoding
+    assert bls_backend.verify(PUBKEYS[0], msg, b"\xff" * 96) is False
+    # infinity signature
+    assert bls_backend.verify(PUBKEYS[0], msg, bls.G2_POINT_AT_INFINITY) is False
+
+
+def test_fast_aggregate_verify_matches_oracle():
+    from consensus_specs_tpu.ops import bls_backend
+
+    msg = MESSAGES[2]
+    sigs = [bls.Sign(sk, msg) for sk in PRIVKEYS[:5]]
+    agg = bls.Aggregate(sigs)
+    pks = PUBKEYS[:5]
+    assert bls.FastAggregateVerify(pks, msg, agg) is True
+    assert bls_backend.fast_aggregate_verify(pks, msg, agg) is True
+    # missing participant
+    assert bls_backend.fast_aggregate_verify(pks[:4], msg, agg) is False
+    # empty
+    assert bls_backend.fast_aggregate_verify([], msg, agg) is False
+    # infinity pubkey in the set
+    inf_pk = b"\xc0" + b"\x00" * 47
+    assert bls_backend.fast_aggregate_verify(pks + [inf_pk], msg, agg) is False
+
+
+def test_batch_fast_aggregate_verify_mixed_validity():
+    from consensus_specs_tpu.ops import bls_backend
+
+    msg_a, msg_b = MESSAGES[0], MESSAGES[1]
+    sig_a = bls.Aggregate([bls.Sign(sk, msg_a) for sk in PRIVKEYS[:3]])
+    sig_b = bls.Aggregate([bls.Sign(sk, msg_b) for sk in PRIVKEYS[3:6]])
+    batch_pks = [PUBKEYS[:3], PUBKEYS[3:6], PUBKEYS[:2], PUBKEYS[:3]]
+    batch_msgs = [msg_a, msg_b, msg_a, msg_b]
+    batch_sigs = [sig_a, sig_b, sig_a, sig_a]  # [valid, valid, wrong-set, wrong-msg]
+    got = bls_backend.batch_fast_aggregate_verify(batch_pks, batch_msgs, batch_sigs)
+    assert list(got) == [True, True, False, False]
+    # every lane must agree with the oracle
+    for pks, m, s, g in zip(batch_pks, batch_msgs, batch_sigs, got):
+        assert bls.FastAggregateVerify(pks, m, s) == bool(g)
+
+
+def test_aggregate_verify_matches_oracle():
+    from consensus_specs_tpu.ops import bls_backend
+
+    pairs = list(zip(PRIVKEYS[:3], MESSAGES[:3]))
+    sigs = [bls.Sign(sk, m) for sk, m in pairs]
+    agg = bls.Aggregate(sigs)
+    pks = PUBKEYS[:3]
+    msgs = MESSAGES[:3]
+    assert bls.AggregateVerify(pks, msgs, agg) is True
+    assert bls_backend.aggregate_verify(pks, msgs, agg) is True
+    # swapped messages
+    assert bls_backend.aggregate_verify(pks, [msgs[1], msgs[0], msgs[2]], agg) is False
+    # mismatched lengths
+    assert bls_backend.aggregate_verify(pks, msgs[:2], agg) is False
+
+
+def test_switchboard_tpu_backend_routing():
+    msg = MESSAGES[3]
+    sig = bls.Sign(PRIVKEYS[7], msg)
+    bls.use_tpu()
+    assert bls.backend_name() == "tpu"
+    assert bls.Verify(PUBKEYS[7], msg, sig) is True
+    assert bls.Verify(PUBKEYS[6], msg, sig) is False
+    agg = bls.Aggregate([bls.Sign(sk, msg) for sk in PRIVKEYS[:2]])
+    assert bls.FastAggregateVerify(PUBKEYS[:2], msg, agg) is True

@@ -6,6 +6,9 @@ import pytest
 
 jax = pytest.importorskip("jax")
 
+# whole-pairing programs: long XLA compiles on the CPU backend
+pytestmark = pytest.mark.slow
+
 from consensus_specs_tpu.ops import curve, fq, pairing, towers as tw  # noqa: E402
 from consensus_specs_tpu.utils import bls12_381 as oracle  # noqa: E402
 from consensus_specs_tpu.utils.bls12_381 import (  # noqa: E402

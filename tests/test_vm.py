@@ -1,0 +1,198 @@
+"""Field-ALU VM correctness: assembler, scheduler, and vmlib formulas vs the
+pure-Python oracle. All programs here share one small shape bucket
+(W=64, steps padded to 256, regs padded to 64) so the suite pays for at most
+one XLA compile (persistent-cached on disk afterwards)."""
+import random
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from consensus_specs_tpu.ops import fq, vm, vmlib  # noqa: E402
+from consensus_specs_tpu.utils import bls12_381 as O  # noqa: E402
+
+rng = random.Random(99)
+
+BUCKET = dict(w_mul=64, w_lin=64, pad_steps_to=256, pad_regs_to=64)
+
+
+def run(prog, ins_ints, batch_shape=()):
+    pr = prog.assemble(**BUCKET)
+    ins = {k: fq.to_mont_int(v) for k, v in ins_ints.items()}
+    out = vm.execute(pr, ins, batch_shape=batch_shape)
+    return {k: fq.from_mont_limbs(v) for k, v in out.items()}
+
+
+def test_alu_chain():
+    prog = vm.Prog()
+    a, b, c, d = (prog.inp(n) for n in "abcd")
+    r = (a * b + c - d) * (a + a) - b
+    prog.out(r, "r")
+    av, bv, cv, dv = (rng.randrange(O.P) for _ in range(4))
+    got = run(prog, dict(a=av, b=bv, c=cv, d=dv))["r"]
+    assert got == (((av * bv + cv - dv) * 2 * av) - bv) % O.P
+
+
+def test_auto_compress_long_chains():
+    # force magnitudes past the lazy-reduction bounds: deep add/sub chains
+    prog = vm.Prog()
+    a = prog.inp("a")
+    b = prog.inp("b")
+    acc = a
+    for _ in range(40):
+        acc = acc + acc  # doubles the bound each time; must auto-compress
+    acc = acc - b
+    acc = acc * acc
+    prog.out(acc, "r")
+    av, bv = rng.randrange(O.P), rng.randrange(O.P)
+    exp = pow((av * (1 << 40) - bv) % O.P, 2, O.P)
+    assert run(prog, dict(a=av, b=bv))["r"] == exp
+
+
+def test_f2_mul_square_vs_oracle():
+    prog = vm.Prog()
+    x = vmlib.f2_inputs(prog, "x")
+    y = vmlib.f2_inputs(prog, "y")
+    m = x * y
+    s = x.square()
+    xi = x.mul_xi()
+    prog.out(m.c0, "m0")
+    prog.out(m.c1, "m1")
+    prog.out(s.c0, "s0")
+    prog.out(s.c1, "s1")
+    prog.out(xi.c0, "xi0")
+    prog.out(xi.c1, "xi1")
+    xv = O.Fq2(rng.randrange(O.P), rng.randrange(O.P))
+    yv = O.Fq2(rng.randrange(O.P), rng.randrange(O.P))
+    got = run(
+        prog,
+        {"x.0": xv.c0, "x.1": xv.c1, "y.0": yv.c0, "y.1": yv.c1},
+    )
+    mv = xv * yv
+    sv = xv * xv
+    xiv = xv * O.Fq2(1, 1)
+    assert (got["m0"], got["m1"]) == (mv.c0, mv.c1)
+    assert (got["s0"], got["s1"]) == (sv.c0, sv.c1)
+    assert (got["xi0"], got["xi1"]) == (xiv.c0, xiv.c1)
+
+
+def _g1_prog_add():
+    prog = vm.Prog()
+    p1 = tuple(prog.inp(f"p.{c}") for c in "xyz")
+    p2 = tuple(prog.inp(f"q.{c}") for c in "xyz")
+    x3, y3, z3 = vmlib.g1_complete_add(prog, p1, p2)
+    prog.out(x3, "x")
+    prog.out(y3, "y")
+    prog.out(z3, "z")
+    return prog
+
+
+def _to_affine_ints(x, y, z):
+    if z == 0:
+        return None
+    zi = pow(z, O.P - 2, O.P)
+    return (x * zi) % O.P, (y * zi) % O.P
+
+
+def _oracle_affine(pt):
+    if pt is None:
+        return None
+    aff = O.ec_to_affine(pt)
+    return aff[0].n, aff[1].n
+
+
+@pytest.mark.parametrize(
+    "k1,k2",
+    [(5, 7), (3, 3), (11, 0), (0, 13), (9, -9), (0, 0)],
+    ids=["generic", "double", "q-inf", "p-inf", "negatives", "both-inf"],
+)
+def test_g1_complete_add_vs_oracle(k1, k2):
+    """RCB complete addition handles generic/double/infinity/inverse cases."""
+    prog = _g1_prog_add()
+
+    def proj(k):
+        if k == 0:
+            return {"x": 0, "y": 1, "z": 0}
+        aff = O.ec_to_affine(O.ec_mul(O.G1_GEN, k % O.R))
+        return {"x": aff[0].n, "y": aff[1].n, "z": 1}
+
+    a, b = proj(k1), proj(k2)
+    ins = {f"p.{c}": a[c] for c in "xyz"}
+    ins.update({f"q.{c}": b[c] for c in "xyz"})
+    got = run(prog, ins)
+    got_aff = _to_affine_ints(got["x"], got["y"], got["z"])
+    exp_pt = O.ec_mul(O.G1_GEN, (k1 + k2) % O.R) if (k1 + k2) % O.R else None
+    assert got_aff == _oracle_affine(exp_pt)
+
+
+def test_g1_tree_sum_vs_oracle():
+    ks = [rng.randrange(1, O.R) for _ in range(5)]
+    prog = vm.Prog()
+    pts = []
+    for j in range(5):
+        pts.append(tuple(prog.inp(f"p{j}.{c}") for c in "xyz"))
+    x3, y3, z3 = vmlib.g1_tree_sum(prog, pts)
+    prog.out(x3, "x")
+    prog.out(y3, "y")
+    prog.out(z3, "z")
+    ins = {}
+    for j, k in enumerate(ks):
+        aff = O.ec_to_affine(O.ec_mul(O.G1_GEN, k))
+        ins[f"p{j}.x"] = aff[0].n
+        ins[f"p{j}.y"] = aff[1].n
+        ins[f"p{j}.z"] = 1
+    got = run(prog, ins)
+    got_aff = _to_affine_ints(got["x"], got["y"], got["z"])
+    assert got_aff == _oracle_affine(O.ec_mul(O.G1_GEN, sum(ks) % O.R))
+
+
+def _rand_fq12():
+    def r2():
+        return O.Fq2(rng.randrange(O.P), rng.randrange(O.P))
+
+    def r6():
+        return O.Fq6(r2(), r2(), r2())
+
+    return O.Fq12(r6(), r6())
+
+
+def _f12_prog(fn, n_out=12):
+    prog = vm.Prog()
+    a = [prog.inp(f"a.{i}") for i in range(12)]
+    r = fn(prog, a)
+    for i in range(12):
+        prog.out(r[i], f"r.{i}")
+    return prog
+
+
+def _f12_run(prog, x: O.Fq12):
+    from consensus_specs_tpu.ops.bls_backend import (
+        _flat_ints_to_oracle,
+        _oracle_to_flat_ints,
+    )
+
+    flat = _oracle_to_flat_ints(x)
+    got = run(prog, {f"a.{i}": flat[i] for i in range(12)})
+    return _flat_ints_to_oracle([got[f"r.{i}"] for i in range(12)])
+
+
+def test_f12_square_and_frobenius_vs_oracle():
+    x = _rand_fq12()
+    assert _f12_run(_f12_prog(vmlib.f12_square), x) == x * x
+    assert _f12_run(
+        _f12_prog(lambda p, a: vmlib.f12_frobenius(p, a, 1)), x
+    ) == x.frobenius()
+    assert _f12_run(
+        _f12_prog(lambda p, a: vmlib.f12_frobenius(p, a, 2)), x
+    ) == x.frobenius().frobenius()
+    assert _f12_run(_f12_prog(vmlib.f12_conj), x) == x.conjugate()
+
+
+def test_f12_cyclotomic_square_vs_oracle():
+    # land a random element in the cyclotomic subgroup via the easy part
+    f = _rand_fq12()
+    g = f.conjugate() * f.inverse()
+    g = g.frobenius().frobenius() * g
+    got = _f12_run(_f12_prog(vmlib.f12_cyclotomic_square), g)
+    assert got == g * g
