@@ -25,6 +25,7 @@ the device, matching the oracle's exception-swallowing wrappers
 (reference utils/bls.py:47-74).
 """
 import functools
+import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -35,7 +36,30 @@ from . import fq, vm, vmlib
 
 DST = b"BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_"
 
-# VM shape buckets (compile cost is per bucket; persistent-cached on disk)
+
+def _enable_persistent_compile_cache() -> None:
+    """Point XLA's persistent compilation cache at a repo-local dir so the
+    per-bucket VM compiles survive process restarts (first compile of a big
+    bucket is 20-40 s; a cache hit is milliseconds)."""
+    path = os.environ.get("JAX_COMPILATION_CACHE_DIR") or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+        ".jax_cache",
+    )
+    try:
+        import jax
+
+        if jax.config.jax_compilation_cache_dir is None:  # explicit setting wins
+            jax.config.update("jax_compilation_cache_dir", path)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass  # cache is an optimization; never fail import over it
+
+
+_enable_persistent_compile_cache()
+
+# VM shape buckets (compile cost is per bucket; the assembled-program build is
+# in-process lru_cached and the XLA executables persist via the compilation
+# cache configured above)
 W_MUL = 64
 W_LIN = 64
 PAD_STEPS = 256

@@ -1,0 +1,48 @@
+"""Process-level JAX platform forcing.
+
+The execution environments this framework runs in (driver, CI, an operator
+shell) may carry ``JAX_PLATFORMS`` pointing at an unreachable accelerator
+tunnel, and a ``sitecustomize`` hook may have imported jax at interpreter
+start — freezing the platform choice before any of our code runs. Setting
+env vars is therefore not enough: the live jax config must be updated and
+any already-initialized backends discarded.
+
+Single home for that logic; the driver entry points (``__graft_entry__``),
+the bench CLI, and the test conftest all call :func:`force_cpu`.
+"""
+import os
+import sys
+from typing import Optional
+
+
+def force_cpu(n_devices: Optional[int] = None) -> None:
+    """Force the CPU platform, optionally with ``n_devices`` virtual devices.
+
+    Safe to call whether or not jax is already imported; must be called
+    before the first device op for the virtual-device count to stick
+    (XLA flags are read at backend initialization).
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if n_devices is not None:
+        flags = [
+            f
+            for f in os.environ.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f
+        ]
+        flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+        os.environ["XLA_FLAGS"] = " ".join(flags)
+
+    if "jax" in sys.modules:
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+        try:
+            # NB: plain `import jax` does NOT expose jax.extend
+            import jax.extend.backend
+
+            jax.extend.backend.clear_backends()
+        except Exception:
+            pass

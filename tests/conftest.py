@@ -7,10 +7,14 @@ Also wires the reference-style CLI flags (--preset/--fork/--disable-bls)
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+# Override — don't setdefault. The outer environment may carry
+# JAX_PLATFORMS=axon (a single-TPU tunnel); under that, the first device op
+# blocks retrying the TPU and the whole session hangs. The CPU-mesh suite
+# must win — even when a sitecustomize hook already imported jax at
+# interpreter start (jax_env handles both cases).
+from consensus_specs_tpu.utils.jax_env import force_cpu  # noqa: E402
+
+force_cpu(n_devices=8)
 
 import pytest  # noqa: E402
 
