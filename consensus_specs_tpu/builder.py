@@ -27,7 +27,7 @@ SPEC_SRC_DIR = Path(__file__).resolve().parent / "specsrc"
 FORK_ORDER = ["phase0", "altair", "merge"]
 
 # forks with authored spec sources; extended as forks land
-IMPLEMENTED_FORKS = ["phase0"]
+IMPLEMENTED_FORKS = ["phase0", "altair"]
 
 SOURCES = {
     "phase0": [
@@ -38,6 +38,7 @@ SOURCES = {
         "weak_subjectivity.py",
     ],
     "altair": [
+        "bls.py",
         "beacon_chain.py",
         "fork.py",
         "sync_protocol.py",
@@ -109,7 +110,18 @@ def _install_prelude(ns: Dict[str, Any], preset_name: str, fork: str) -> None:
     from .utils import bls
     from .utils.hash_function import hash as _hash
     from .utils.ssz import ssz_typing as tz
+    from .utils.ssz.gindex import GeneralizedIndex, get_generalized_index
     from .utils.ssz.ssz_impl import copy, hash_tree_root, serialize, uint_to_bytes
+
+    def ceillog2(x: int) -> tz.uint64:
+        if x < 1:
+            raise ValueError(f"ceillog2 accepts only positive values, x={x}")
+        return tz.uint64((x - 1).bit_length())
+
+    def floorlog2(x: int) -> tz.uint64:
+        if x < 1:
+            raise ValueError(f"floorlog2 accepts only positive values, x={x}")
+        return tz.uint64(x.bit_length() - 1)
 
     ns.update(
         dict(
@@ -130,6 +142,10 @@ def _install_prelude(ns: Dict[str, Any], preset_name: str, fork: str) -> None:
             # crypto / ssz impl
             bls=bls, hash=_hash, hash_tree_root=hash_tree_root,
             serialize=serialize, copy=copy, uint_to_bytes=uint_to_bytes,
+            # merkle-proof algebra (reference setup.py:46-57, :466-472)
+            GeneralizedIndex=GeneralizedIndex,
+            get_generalized_index=get_generalized_index,
+            ceillog2=ceillog2, floorlog2=floorlog2,
         )
     )
 
@@ -224,16 +240,31 @@ def build_spec_module(fork: str, preset_name: str) -> types.ModuleType:
         return _built[key]
     if fork not in FORK_ORDER:
         raise ValueError(f"unknown fork {fork!r}")
+    if fork not in IMPLEMENTED_FORKS:
+        # never hand back a silently mis-layered module for a fork whose
+        # sources don't exist yet
+        raise NotImplementedError(
+            f"fork {fork!r} has no spec sources (implemented: {IMPLEMENTED_FORKS})"
+        )
     mod_name = f"consensus_specs_tpu.{fork}.{preset_name}"
     module = types.ModuleType(mod_name)
     ns = module.__dict__
     _install_prelude(ns, preset_name, fork)
     lineage = FORK_ORDER[: FORK_ORDER.index(fork) + 1]
+    # previous-fork modules bound FIRST: spec sources reference them in
+    # eagerly-evaluated annotations (e.g. `pre: phase0.BeaconState`,
+    # reference specs/altair/fork.md:62) as well as in function bodies
+    for prev in lineage[:-1]:
+        ns[prev] = build_spec_module(prev, preset_name)
     for fk in lineage:
         for src in SOURCES[fk]:
             path = SPEC_SRC_DIR / fk / src
             if not path.exists():
-                continue
+                # a missing source for an implemented fork is a build error,
+                # not a skip — silent skipping shipped a broken altair once
+                raise FileNotFoundError(
+                    f"spec source missing for implemented fork {fk!r}: {path}"
+                )
             code = compile(path.read_text(), str(path), "exec")
             exec(code, ns)
     module.fork = fork
@@ -241,9 +272,6 @@ def build_spec_module(fork: str, preset_name: str) -> types.ModuleType:
     _apply_optimizations(ns)
     _built[key] = module
     sys.modules[mod_name] = module
-    # previous-fork modules importable for transition helpers
-    for prev in lineage[:-1]:
-        ns[prev] = build_spec_module(prev, preset_name)
     return module
 
 
@@ -253,6 +281,6 @@ def spec_targets() -> Dict[str, Dict[str, types.ModuleType]]:
     out: Dict[str, Dict[str, types.ModuleType]] = {}
     for preset in ("minimal", "mainnet"):
         out[preset] = {}
-        for fork in FORK_ORDER:
+        for fork in IMPLEMENTED_FORKS:
             out[preset][fork] = build_spec_module(fork, preset)
     return out

@@ -274,7 +274,7 @@ def get_inactivity_penalty_deltas(state: BeaconState) -> Tuple[Sequence[Gwei], S
     for index in get_eligible_validator_indices(state):
         if index not in matching_target_indices:
             penalty_numerator = state.validators[index].effective_balance * state.inactivity_scores[index]
-            penalty_denominator = INACTIVITY_SCORE_BIAS * INACTIVITY_PENALTY_QUOTIENT_ALTAIR
+            penalty_denominator = config.INACTIVITY_SCORE_BIAS * INACTIVITY_PENALTY_QUOTIENT_ALTAIR
             penalties[index] += Gwei(penalty_numerator // penalty_denominator)
     return rewards, penalties
 
@@ -476,10 +476,10 @@ def process_inactivity_updates(state: BeaconState) -> None:
         if index in get_unslashed_participating_indices(state, TIMELY_TARGET_FLAG_INDEX, get_previous_epoch(state)):
             state.inactivity_scores[index] -= min(1, state.inactivity_scores[index])
         else:
-            state.inactivity_scores[index] += INACTIVITY_SCORE_BIAS
+            state.inactivity_scores[index] += config.INACTIVITY_SCORE_BIAS
         # Decrease the inactivity score of all eligible validators during a leak-free epoch
         if not is_in_inactivity_leak(state):
-            state.inactivity_scores[index] -= min(INACTIVITY_SCORE_RECOVERY_RATE, state.inactivity_scores[index])
+            state.inactivity_scores[index] -= min(config.INACTIVITY_SCORE_RECOVERY_RATE, state.inactivity_scores[index])
 
 
 def process_rewards_and_penalties(state: BeaconState) -> None:

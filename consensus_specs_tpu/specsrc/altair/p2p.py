@@ -1,0 +1,29 @@
+# Altair — P2P networking interface: the executable artifacts
+#
+# The computable parts of reference specs/altair/p2p-interface.md: the
+# sync-committee subnet helper and the extended MetaData. The gossip
+# transport itself is specified, not executed (SURVEY.md §2.7/P5).
+
+
+class MetaData(Container):
+    # (altair/p2p-interface.md — adds the `syncnets` bitfield advertised in
+    # the ENR for sync-committee subnet stability)
+    seq_number: uint64
+    attnets: Bitvector[ATTESTATION_SUBNET_COUNT]
+    syncnets: Bitvector[SYNC_COMMITTEE_SUBNET_COUNT]
+
+
+def get_sync_subcommittee_pubkeys(state: BeaconState, subcommittee_index: uint64) -> Sequence[BLSPubkey]:
+    # (altair/p2p-interface.md:124-138 — gossip-validation convenience)
+    # Committees assigned to `slot` sign for `slot - 1`
+    # This creates the exceptional logic below when transitioning between sync committee periods
+    next_slot_epoch = compute_epoch_at_slot(Slot(state.slot + 1))
+    if compute_sync_committee_period(get_current_epoch(state)) == compute_sync_committee_period(next_slot_epoch):
+        sync_committee = state.current_sync_committee
+    else:
+        sync_committee = state.next_sync_committee
+
+    # Return pubkeys for the subcommittee index
+    sync_subcommittee_size = SYNC_COMMITTEE_SIZE // SYNC_COMMITTEE_SUBNET_COUNT
+    i = subcommittee_index * sync_subcommittee_size
+    return sync_committee.pubkeys[i:i + sync_subcommittee_size]

@@ -1,0 +1,138 @@
+# Altair — Minimal Light Client Sync Protocol (executable spec source)
+#
+# Provenance: function bodies transcribed from the spec text (reference
+# specs/altair/sync-protocol.md:40-195) — conformance requires identical
+# semantics. The two generalized indices are hardcoded with an assertion
+# against the SSZ-derived values, mirroring reference setup.py:476-481,
+# 634-635, 654-656.
+
+FINALIZED_ROOT_INDEX = GeneralizedIndex(105)
+NEXT_SYNC_COMMITTEE_INDEX = GeneralizedIndex(55)
+
+assert FINALIZED_ROOT_INDEX == get_generalized_index(BeaconState, 'finalized_checkpoint', 'root')
+assert NEXT_SYNC_COMMITTEE_INDEX == get_generalized_index(BeaconState, 'next_sync_committee')
+
+# Preset (sync-protocol.md:47-53)
+MIN_SYNC_COMMITTEE_PARTICIPANTS = 1
+
+
+class LightClientSnapshot(Container):
+    # (sync-protocol.md:56-65)
+    # Beacon block header
+    header: BeaconBlockHeader
+    # Sync committees corresponding to the header
+    current_sync_committee: SyncCommittee
+    next_sync_committee: SyncCommittee
+
+
+class LightClientUpdate(Container):
+    # (sync-protocol.md:67-85)
+    # Update beacon block header
+    header: BeaconBlockHeader
+    # Next sync committee corresponding to the header
+    next_sync_committee: SyncCommittee
+    next_sync_committee_branch: Vector[Bytes32, floorlog2(NEXT_SYNC_COMMITTEE_INDEX)]
+    # Finality proof for the update header
+    finality_header: BeaconBlockHeader
+    finality_branch: Vector[Bytes32, floorlog2(FINALIZED_ROOT_INDEX)]
+    # Sync committee aggregate signature
+    sync_committee_bits: Bitvector[SYNC_COMMITTEE_SIZE]
+    sync_committee_signature: BLSSignature
+    # Fork version for the aggregate signature
+    fork_version: Version
+
+
+@dataclass
+class LightClientStore(object):
+    # (sync-protocol.md:86-95)
+    snapshot: LightClientSnapshot
+    valid_updates: Set[LightClientUpdate]
+
+
+def get_subtree_index(generalized_index: GeneralizedIndex) -> uint64:
+    # (sync-protocol.md:99-104)
+    return uint64(generalized_index % 2**(floorlog2(generalized_index)))
+
+
+def validate_light_client_update(snapshot: LightClientSnapshot,
+                                 update: LightClientUpdate,
+                                 genesis_validators_root: Root) -> None:
+    # (sync-protocol.md:108-159 — merkle-branch checks + one
+    # FastAggregateVerify over the participating sync-committee subset)
+    # Verify update slot is larger than snapshot slot
+    assert update.header.slot > snapshot.header.slot
+
+    # Verify update does not skip a sync committee period
+    snapshot_period = compute_epoch_at_slot(snapshot.header.slot) // EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+    update_period = compute_epoch_at_slot(update.header.slot) // EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+    assert update_period in (snapshot_period, snapshot_period + 1)
+
+    # Verify update header root is the finalized root of the finality header, if specified
+    if update.finality_header == BeaconBlockHeader():
+        signed_header = update.header
+        assert update.finality_branch == [Bytes32() for _ in range(floorlog2(FINALIZED_ROOT_INDEX))]
+    else:
+        signed_header = update.finality_header
+        assert is_valid_merkle_branch(
+            leaf=hash_tree_root(update.header),
+            branch=update.finality_branch,
+            depth=floorlog2(FINALIZED_ROOT_INDEX),
+            index=get_subtree_index(FINALIZED_ROOT_INDEX),
+            root=update.finality_header.state_root,
+        )
+
+    # Verify update next sync committee if the update period incremented
+    if update_period == snapshot_period:
+        sync_committee = snapshot.current_sync_committee
+        assert update.next_sync_committee_branch == [Bytes32() for _ in range(floorlog2(NEXT_SYNC_COMMITTEE_INDEX))]
+    else:
+        sync_committee = snapshot.next_sync_committee
+        assert is_valid_merkle_branch(
+            leaf=hash_tree_root(update.next_sync_committee),
+            branch=update.next_sync_committee_branch,
+            depth=floorlog2(NEXT_SYNC_COMMITTEE_INDEX),
+            index=get_subtree_index(NEXT_SYNC_COMMITTEE_INDEX),
+            root=update.header.state_root,
+        )
+
+    # Verify sync committee has sufficient participants
+    assert sum(update.sync_committee_bits) >= MIN_SYNC_COMMITTEE_PARTICIPANTS
+
+    # Verify sync committee aggregate signature
+    participant_pubkeys = [pubkey for (bit, pubkey) in zip(update.sync_committee_bits, sync_committee.pubkeys) if bit]
+    domain = compute_domain(DOMAIN_SYNC_COMMITTEE, update.fork_version, genesis_validators_root)
+    signing_root = compute_signing_root(signed_header, domain)
+    assert bls.FastAggregateVerify(participant_pubkeys, signing_root, update.sync_committee_signature)
+
+
+def apply_light_client_update(snapshot: LightClientSnapshot, update: LightClientUpdate) -> None:
+    # (sync-protocol.md:160-172)
+    snapshot_period = compute_epoch_at_slot(snapshot.header.slot) // EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+    update_period = compute_epoch_at_slot(update.header.slot) // EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+    if update_period == snapshot_period + 1:
+        snapshot.current_sync_committee = snapshot.next_sync_committee
+        snapshot.next_sync_committee = update.next_sync_committee
+    snapshot.header = update.header
+
+
+def process_light_client_update(store: LightClientStore, update: LightClientUpdate, current_slot: Slot,
+                                genesis_validators_root: Root) -> None:
+    # (sync-protocol.md:174-195 — 2/3-supermajority + finality-proof apply,
+    # with a forced best-update path after the timeout)
+    validate_light_client_update(store.snapshot, update, genesis_validators_root)
+    store.valid_updates.add(update)
+
+    update_timeout = SLOTS_PER_EPOCH * EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+    if (
+        sum(update.sync_committee_bits) * 3 >= len(update.sync_committee_bits) * 2
+        and update.finality_header != BeaconBlockHeader()
+    ):
+        # Apply update if (1) 2/3 quorum is reached and (2) we have a finality proof.
+        # Note that (2) means that the current light client design needs finality.
+        apply_light_client_update(store.snapshot, update)
+        store.valid_updates = set()
+    elif current_slot > store.snapshot.header.slot + update_timeout:
+        # Forced best update when the update timeout has elapsed
+        apply_light_client_update(store.snapshot,
+                                  max(store.valid_updates, key=lambda update: sum(update.sync_committee_bits)))
+        store.valid_updates = set()

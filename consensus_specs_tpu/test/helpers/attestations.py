@@ -1,5 +1,6 @@
 """Attestation-building helpers (reference: test/helpers/attestations.py)."""
 from .block import build_empty_block_for_next_slot
+from .forks import is_post_altair
 from .keys import privkeys
 from .state import next_slot, state_transition_and_sign_block, transition_to
 
@@ -20,17 +21,37 @@ def run_attestation_processing(spec, state, attestation, valid=True):
         yield 'post', None
         return
 
-    current_epoch_count = len(state.current_epoch_attestations)
-    previous_epoch_count = len(state.previous_epoch_attestations)
+    is_current_target = attestation.data.target.epoch == spec.get_current_epoch(state)
+    if not is_post_altair(spec):
+        current_epoch_count = len(state.current_epoch_attestations)
+        previous_epoch_count = len(state.previous_epoch_attestations)
+    else:
+        # altair+: participation flags replace the PendingAttestation queues —
+        # work out which flags this attestation should set, then check them
+        expected_flags = spec.get_attestation_participation_flag_indices(
+            state, attestation.data, state.slot - attestation.data.slot
+        )
+        attesting = list(spec.get_attesting_indices(
+            state, attestation.data, attestation.aggregation_bits
+        ))
 
     # process attestation
     spec.process_attestation(state, attestation)
 
     # Make sure the attestation has been processed
-    if attestation.data.target.epoch == spec.get_current_epoch(state):
-        assert len(state.current_epoch_attestations) == current_epoch_count + 1
+    if not is_post_altair(spec):
+        if is_current_target:
+            assert len(state.current_epoch_attestations) == current_epoch_count + 1
+        else:
+            assert len(state.previous_epoch_attestations) == previous_epoch_count + 1
     else:
-        assert len(state.previous_epoch_attestations) == previous_epoch_count + 1
+        participation = (
+            state.current_epoch_participation if is_current_target
+            else state.previous_epoch_participation
+        )
+        for index in attesting:
+            for flag_index in expected_flags:
+                assert spec.has_flag(participation[index], flag_index)
 
     # yield post-state
     yield 'post', state

@@ -1,4 +1,5 @@
 """Block-building helpers (reference: test/helpers/block.py)."""
+from .forks import is_post_altair
 from .keys import privkeys
 
 
@@ -67,8 +68,16 @@ def build_empty_block(spec, state, slot=None):
     empty_block.body.eth1_data.deposit_count = state.eth1_deposit_index
     empty_block.parent_root = parent_block_root
 
+    if is_post_altair(spec):
+        # an empty-participation sync aggregate carries the infinity-point
+        # signature, which eth_fast_aggregate_verify accepts for zero
+        # participants (reference specs/altair/bls.md:59-68); the default
+        # all-zero BLSSignature would fail verification
+        empty_block.body.sync_aggregate.sync_committee_signature = spec.G2_POINT_AT_INFINITY
+
     apply_randao_reveal(spec, state, empty_block)
     return empty_block
+
 
 
 def build_empty_block_for_next_slot(spec, state):

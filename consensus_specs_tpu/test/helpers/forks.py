@@ -1,0 +1,14 @@
+"""Fork predicates for test helpers that branch on the state/block shape.
+
+(reference: test/helpers/constants.py fork-name registry :8-31; the reference
+compares `spec.fork` against those names at helper branch points)
+"""
+from ..context import ALTAIR, MERGE, PHASE0
+
+
+def is_post_altair(spec) -> bool:
+    return spec.fork not in (PHASE0,)
+
+
+def is_post_merge(spec) -> bool:
+    return spec.fork in (MERGE,)

@@ -2,6 +2,7 @@
 
 (reference: tests/core/pyspec/eth2spec/test/helpers/genesis.py:42-103)
 """
+from .forks import is_post_altair, is_post_merge
 from .keys import pubkeys
 
 
@@ -31,7 +32,7 @@ def create_genesis_state(spec, validator_balances, activation_threshold):
     current_version = spec.config.GENESIS_FORK_VERSION
     if spec.fork == "altair":
         current_version = spec.config.ALTAIR_FORK_VERSION
-    elif spec.fork == "merge":
+    elif is_post_merge(spec):
         previous_version = spec.config.ALTAIR_FORK_VERSION
         current_version = spec.config.MERGE_FORK_VERSION
 
@@ -71,7 +72,7 @@ def create_genesis_state(spec, validator_balances, activation_threshold):
     # Set genesis validators root for domain separation and chain versioning
     state.genesis_validators_root = spec.hash_tree_root(state.validators)
 
-    if spec.fork in ("altair", "merge"):
+    if is_post_altair(spec):
         # Fill in participation roots and sync committees (altair+)
         state.previous_epoch_participation = [spec.ParticipationFlags(0)] * len(state.validators)
         state.current_epoch_participation = [spec.ParticipationFlags(0)] * len(state.validators)
@@ -80,7 +81,7 @@ def create_genesis_state(spec, validator_balances, activation_threshold):
         state.current_sync_committee = spec.get_next_sync_committee(state)
         state.next_sync_committee = spec.get_next_sync_committee(state)
 
-    if spec.fork == "merge":
+    if is_post_merge(spec):
         # Initialize the execution payload header (with an empty transactions root)
         state.latest_execution_payload_header = spec.ExecutionPayloadHeader()
 

@@ -824,6 +824,19 @@ class Container(View):
             getattr(self, n) == getattr(other, n) for n in self._field_types
         )
 
+    @classmethod
+    def coerce_view(cls, value: Any) -> "Container":
+        if isinstance(value, cls):
+            return value
+        if isinstance(value, Container) and list(value._field_types) == list(cls._field_types):
+            # same field names (e.g. the same container re-declared in a later
+            # fork's built module, or an upgrade_to_* carrying fields across):
+            # rebuild field-by-field, coercing recursively
+            return cls(**{n: getattr(value, n) for n in cls._field_types})
+        if isinstance(value, dict):
+            return cls(**value)
+        raise TypeError(f"cannot coerce {type(value).__name__} to {cls.__name__}")
+
     def __hash__(self):
         return hash(self.hash_tree_root())
 
