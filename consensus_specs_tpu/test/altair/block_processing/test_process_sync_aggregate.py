@@ -1,0 +1,197 @@
+"""process_sync_aggregate tests — the 512-wide second BLS hot path
+(spec: reference specs/altair/beacon-chain.md:535-565; scenario coverage
+modeled on the reference's altair/block_processing/sync_aggregate suite,
+written for this harness).
+"""
+from ...context import ALTAIR, always_bls, spec_state_test, with_phases
+from ...helpers.state import transition_to
+from ...helpers.sync_committee import (
+    build_sync_aggregate,
+    compute_aggregate_sync_committee_signature,
+    compute_sync_committee_participant_reward_and_penalty,
+    get_committee_indices,
+)
+
+
+def _prepare(spec, state):
+    # move off genesis so previous-slot block roots exist
+    transition_to(spec, state, state.slot + 3)
+
+
+def run_sync_aggregate_processing(spec, state, sync_aggregate, valid=True):
+    from ...context import expect_assertion_error
+
+    yield 'pre', state
+    yield 'sync_aggregate', sync_aggregate
+
+    if not valid:
+        expect_assertion_error(
+            lambda: spec.process_sync_aggregate(state, sync_aggregate)
+        )
+        yield 'post', None
+        return
+
+    committee_indices = get_committee_indices(spec, state)
+    participant_reward, proposer_reward = (
+        compute_sync_committee_participant_reward_and_penalty(spec, state)
+    )
+    proposer_index = spec.get_beacon_proposer_index(state)
+    pre_balances = [int(b) for b in state.balances]
+
+    spec.process_sync_aggregate(state, sync_aggregate)
+
+    # reconstruct the expected balance deltas seat by seat
+    expected = list(pre_balances)
+    for seat, bit in zip(committee_indices, sync_aggregate.sync_committee_bits):
+        if bit:
+            expected[seat] += int(participant_reward)
+            expected[proposer_index] += int(proposer_reward)
+        else:
+            expected[seat] = max(0, expected[seat] - int(participant_reward))
+    assert [int(b) for b in state.balances] == expected
+
+    yield 'post', state
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+def test_sync_committee_full_participation(spec, state):
+    _prepare(spec, state)
+    bits = [True] * int(spec.SYNC_COMMITTEE_SIZE)
+    sync_aggregate = build_sync_aggregate(spec, state, bits)
+    yield from run_sync_aggregate_processing(spec, state, sync_aggregate)
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+def test_sync_committee_empty_participation(spec, state):
+    # zero participants with the infinity-point signature is explicitly valid
+    # (reference specs/altair/bls.md:59-68)
+    _prepare(spec, state)
+    bits = [False] * int(spec.SYNC_COMMITTEE_SIZE)
+    sync_aggregate = spec.SyncAggregate(
+        sync_committee_bits=bits,
+        sync_committee_signature=spec.G2_POINT_AT_INFINITY,
+    )
+    yield from run_sync_aggregate_processing(spec, state, sync_aggregate)
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+def test_sync_committee_half_participation(spec, state):
+    _prepare(spec, state)
+    size = int(spec.SYNC_COMMITTEE_SIZE)
+    bits = [i % 2 == 0 for i in range(size)]
+    sync_aggregate = build_sync_aggregate(spec, state, bits)
+    yield from run_sync_aggregate_processing(spec, state, sync_aggregate)
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+@always_bls
+def test_invalid_signature_zeroed_with_participation(spec, state):
+    # participants claimed but the signature is the zero encoding
+    _prepare(spec, state)
+    bits = [True] * int(spec.SYNC_COMMITTEE_SIZE)
+    sync_aggregate = spec.SyncAggregate(sync_committee_bits=bits)
+    yield from run_sync_aggregate_processing(spec, state, sync_aggregate, valid=False)
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+@always_bls
+def test_invalid_signature_infinity_with_participation(spec, state):
+    # the infinity signature is only acceptable for empty participation
+    _prepare(spec, state)
+    bits = [True] * int(spec.SYNC_COMMITTEE_SIZE)
+    sync_aggregate = spec.SyncAggregate(
+        sync_committee_bits=bits,
+        sync_committee_signature=spec.G2_POINT_AT_INFINITY,
+    )
+    yield from run_sync_aggregate_processing(spec, state, sync_aggregate, valid=False)
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+@always_bls
+def test_invalid_signature_missing_participant(spec, state):
+    # one claimed participant did not actually sign
+    _prepare(spec, state)
+    size = int(spec.SYNC_COMMITTEE_SIZE)
+    committee_indices = get_committee_indices(spec, state)
+    bits = [True] * size
+    signers = [committee_indices[i] for i in range(size) if i != 0]
+    signature = compute_aggregate_sync_committee_signature(
+        spec, state, state.slot, signers
+    )
+    sync_aggregate = spec.SyncAggregate(
+        sync_committee_bits=bits, sync_committee_signature=signature
+    )
+    yield from run_sync_aggregate_processing(spec, state, sync_aggregate, valid=False)
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+@always_bls
+def test_invalid_signature_extra_participant(spec, state):
+    # signature covers a seat whose bit is cleared
+    _prepare(spec, state)
+    size = int(spec.SYNC_COMMITTEE_SIZE)
+    committee_indices = get_committee_indices(spec, state)
+    bits = [i != 0 for i in range(size)]
+    signers = list(committee_indices)  # includes seat 0
+    signature = compute_aggregate_sync_committee_signature(
+        spec, state, state.slot, signers
+    )
+    sync_aggregate = spec.SyncAggregate(
+        sync_committee_bits=bits, sync_committee_signature=signature
+    )
+    yield from run_sync_aggregate_processing(spec, state, sync_aggregate, valid=False)
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+@always_bls
+def test_invalid_signature_wrong_root(spec, state):
+    # correct signers, wrong message (a bogus block root)
+    _prepare(spec, state)
+    size = int(spec.SYNC_COMMITTEE_SIZE)
+    committee_indices = get_committee_indices(spec, state)
+    bits = [True] * size
+    signature = compute_aggregate_sync_committee_signature(
+        spec, state, state.slot, committee_indices, block_root=b'\x25' * 32
+    )
+    sync_aggregate = spec.SyncAggregate(
+        sync_committee_bits=bits, sync_committee_signature=signature
+    )
+    yield from run_sync_aggregate_processing(spec, state, sync_aggregate, valid=False)
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+def test_sync_committee_rewards_duplicate_committee_member(spec, state):
+    # minimal preset committees (32 seats over 64 validators) routinely seat
+    # the same validator more than once; each seat rewards/penalizes
+    # independently — the runner's seat-by-seat model checks exactly that
+    _prepare(spec, state)
+    size = int(spec.SYNC_COMMITTEE_SIZE)
+    committee_indices = get_committee_indices(spec, state)
+    assert len(set(committee_indices)) <= size  # duplicates possible
+    bits = [i % 4 != 0 for i in range(size)]
+    sync_aggregate = build_sync_aggregate(spec, state, bits)
+    yield from run_sync_aggregate_processing(spec, state, sync_aggregate)
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+def test_sync_committee_proposer_in_committee(spec, state):
+    # proposer earns both its seat reward (if participating) and the
+    # per-participant proposer reward
+    _prepare(spec, state)
+    bits = [True] * int(spec.SYNC_COMMITTEE_SIZE)
+    sync_aggregate = build_sync_aggregate(spec, state, bits)
+    proposer = spec.get_beacon_proposer_index(state)
+    committee_indices = get_committee_indices(spec, state)
+    yield from run_sync_aggregate_processing(spec, state, sync_aggregate)
+    # informational: whether the proposer held a seat in this committee
+    _ = proposer in committee_indices

@@ -1,0 +1,51 @@
+"""process_inactivity_updates tests
+(spec: reference specs/altair/beacon-chain.md:603-622)."""
+from ...context import ALTAIR, spec_state_test, with_phases
+from ...helpers.attestations import next_epoch_with_attestations
+from ...helpers.epoch_processing import run_epoch_processing_with
+from ...helpers.state import next_epoch
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+def test_genesis_epoch_no_op(spec, state):
+    assert spec.get_current_epoch(state) == spec.GENESIS_EPOCH
+    state.inactivity_scores = [spec.uint64(7)] * len(state.validators)
+    yield from run_epoch_processing_with(spec, state, 'process_inactivity_updates')
+    # genesis epoch: untouched
+    assert all(int(s) == 7 for s in state.inactivity_scores)
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+def test_all_inactive_scores_rise(spec, state):
+    # nobody attests: every eligible validator's score += INACTIVITY_SCORE_BIAS,
+    # then -= min(RATE, score) since there is no leak this early
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    bias = int(spec.config.INACTIVITY_SCORE_BIAS)
+    rate = int(spec.config.INACTIVITY_SCORE_RECOVERY_RATE)
+    state.inactivity_scores = [spec.uint64(100)] * len(state.validators)
+    in_leak = spec.is_in_inactivity_leak(state)
+    yield from run_epoch_processing_with(spec, state, 'process_inactivity_updates')
+    expected = 100 + bias - (0 if in_leak else min(rate, 100 + bias))
+    for index in spec.get_eligible_validator_indices(state):
+        assert int(state.inactivity_scores[index]) == expected
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+def test_full_participation_scores_drop(spec, state):
+    # everyone attests with timely target: score -= min(1, score), then the
+    # leak-free recovery subtracts min(RATE, score)
+    state, _, post = next_epoch_with_attestations(spec, state, True, False)
+    state = post
+    rate = int(spec.config.INACTIVITY_SCORE_RECOVERY_RATE)
+    state.inactivity_scores = [spec.uint64(50)] * len(state.validators)
+    participating = spec.get_unslashed_participating_indices(
+        state, spec.TIMELY_TARGET_FLAG_INDEX, spec.get_previous_epoch(state)
+    )
+    assert len(participating) > 0
+    yield from run_epoch_processing_with(spec, state, 'process_inactivity_updates')
+    for index in participating:
+        assert int(state.inactivity_scores[index]) == 50 - 1 - min(rate, 49)

@@ -1,0 +1,43 @@
+"""process_participation_flag_updates tests
+(spec: reference specs/altair/beacon-chain.md:659-667)."""
+from random import Random
+
+from ...context import ALTAIR, spec_state_test, with_phases
+from ...helpers.epoch_processing import run_epoch_processing_with
+
+
+def _randomize_flags(spec, state, rng):
+    n = len(state.validators)
+    state.previous_epoch_participation = [
+        spec.ParticipationFlags(rng.randrange(8)) for _ in range(n)
+    ]
+    state.current_epoch_participation = [
+        spec.ParticipationFlags(rng.randrange(8)) for _ in range(n)
+    ]
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+def test_rotation(spec, state):
+    _randomize_flags(spec, state, Random(2203))
+    pre_current = list(state.current_epoch_participation)
+    yield from run_epoch_processing_with(
+        spec, state, 'process_participation_flag_updates'
+    )
+    assert list(state.previous_epoch_participation) == pre_current
+    assert list(state.current_epoch_participation) == (
+        [spec.ParticipationFlags(0)] * len(state.validators)
+    )
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+def test_rotation_all_zeroed(spec, state):
+    n = len(state.validators)
+    state.previous_epoch_participation = [spec.ParticipationFlags(7)] * n
+    state.current_epoch_participation = [spec.ParticipationFlags(0)] * n
+    yield from run_epoch_processing_with(
+        spec, state, 'process_participation_flag_updates'
+    )
+    assert list(state.previous_epoch_participation) == [spec.ParticipationFlags(0)] * n
+    assert list(state.current_epoch_participation) == [spec.ParticipationFlags(0)] * n

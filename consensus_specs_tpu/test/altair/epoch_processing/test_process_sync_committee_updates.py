@@ -1,0 +1,41 @@
+"""process_sync_committee_updates tests
+(spec: reference specs/altair/beacon-chain.md:669-679)."""
+from ...context import ALTAIR, MINIMAL, spec_state_test, with_phases, with_presets
+from ...helpers.epoch_processing import run_epoch_processing_with
+from ...helpers.state import transition_to
+
+
+@with_phases([ALTAIR])
+@with_presets([MINIMAL], reason="period transition needs few epochs only on minimal")
+@spec_state_test
+def test_sync_committees_progress_at_period_boundary(spec, state):
+    # move to the last epoch of the first sync-committee period
+    period_epochs = int(spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD)
+    transition_to(
+        spec, state, (period_epochs - 1) * spec.SLOTS_PER_EPOCH
+    )
+    pre_current = state.current_sync_committee.copy()
+    pre_next = state.next_sync_committee.copy()
+
+    yield from run_epoch_processing_with(spec, state, 'process_sync_committee_updates')
+
+    # rotation: next becomes current, a freshly computed committee fills next
+    assert state.current_sync_committee == pre_next
+    assert state.next_sync_committee == spec.get_next_sync_committee(state)
+    _ = pre_current  # superseded
+
+
+@with_phases([ALTAIR])
+@with_presets([MINIMAL], reason="period transition needs few epochs only on minimal")
+@spec_state_test
+def test_sync_committees_no_progress_mid_period(spec, state):
+    period_epochs = int(spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD)
+    assert period_epochs > 2
+    transition_to(spec, state, spec.SLOTS_PER_EPOCH)  # epoch 1, mid-period
+    pre_current = state.current_sync_committee.copy()
+    pre_next = state.next_sync_committee.copy()
+
+    yield from run_epoch_processing_with(spec, state, 'process_sync_committee_updates')
+
+    assert state.current_sync_committee == pre_current
+    assert state.next_sync_committee == pre_next

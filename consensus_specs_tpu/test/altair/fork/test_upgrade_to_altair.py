@@ -1,0 +1,73 @@
+"""upgrade_to_altair fork-transition tests
+(spec: reference specs/altair/fork.md:40-107; scenario coverage modeled on
+the reference's altair/fork suite, written for this harness)."""
+from ...context import (
+    ALTAIR, PHASE0, spec_state_test, with_phases,
+)
+from ...helpers.attestations import next_epoch_with_attestations
+from ...helpers.state import next_epoch
+
+
+def _upgrade(phases, pre_state):
+    altair = phases[ALTAIR]
+    post = altair.upgrade_to_altair(pre_state)
+    # invariants that must hold for every upgrade
+    assert post.fork.previous_version == pre_state.fork.current_version
+    assert post.fork.current_version == altair.config.ALTAIR_FORK_VERSION
+    assert post.fork.epoch == phases[PHASE0].get_current_epoch(pre_state)
+    assert post.genesis_time == pre_state.genesis_time
+    assert post.genesis_validators_root == pre_state.genesis_validators_root
+    assert post.slot == pre_state.slot
+    assert len(post.validators) == len(pre_state.validators)
+    assert list(post.balances) == list(pre_state.balances)
+    assert list(post.inactivity_scores) == [0] * len(pre_state.validators)
+    assert post.current_sync_committee == altair.get_next_sync_committee(post)
+    return post
+
+
+@with_phases([PHASE0], other_phases=[ALTAIR])
+@spec_state_test
+def test_upgrade_fresh_state(spec, state, phases):
+    yield 'pre', state
+    post = _upgrade(phases, state)
+    # no pending attestations -> participation stays empty
+    altair = phases[ALTAIR]
+    assert list(post.previous_epoch_participation) == (
+        [altair.ParticipationFlags(0)] * len(post.validators)
+    )
+    yield 'post', post
+
+
+@with_phases([PHASE0], other_phases=[ALTAIR])
+@spec_state_test
+def test_upgrade_after_epochs(spec, state, phases):
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    yield 'pre', state
+    post = _upgrade(phases, state)
+    yield 'post', post
+
+
+@with_phases([PHASE0], other_phases=[ALTAIR])
+@spec_state_test
+def test_upgrade_translates_participation(spec, state, phases):
+    # a full epoch of attestations leaves previous_epoch_attestations
+    # populated; the upgrade must translate them into participation flags
+    next_epoch(spec, state)  # leave the genesis epoch before back-filling
+    state, _, post_state = next_epoch_with_attestations(spec, state, False, True)
+    state = post_state
+    assert len(state.previous_epoch_attestations) > 0
+    yield 'pre', state
+    post = _upgrade(phases, state)
+    altair = phases[ALTAIR]
+    flagged = [
+        i for i, flags in enumerate(post.previous_epoch_participation)
+        if int(flags) != 0
+    ]
+    assert len(flagged) > 0
+    # every flagged validator attested in the pre-state
+    attesters = set()
+    for att in state.previous_epoch_attestations:
+        attesters |= set(spec.get_attesting_indices(state, att.data, att.aggregation_bits))
+    assert set(flagged) <= attesters
+    yield 'post', post

@@ -2,11 +2,28 @@
 
 
 def get_process_calls(spec):
-    # ordered epoch-processing sub-passes per fork
+    # ordered epoch-processing sub-passes per fork; fork-dependent because
+    # the altair namespace still carries phase0's superseded passes
     # (reference specs/phase0/beacon-chain.md:1286-1298; altair:567-583)
+    from .forks import is_post_altair
+
+    if is_post_altair(spec):
+        return [
+            'process_justification_and_finalization',
+            'process_inactivity_updates',
+            'process_rewards_and_penalties',
+            'process_registry_updates',
+            'process_slashings',
+            'process_eth1_data_reset',
+            'process_effective_balance_updates',
+            'process_slashings_reset',
+            'process_randao_mixes_reset',
+            'process_historical_roots_update',
+            'process_participation_flag_updates',
+            'process_sync_committee_updates',
+        ]
     return [
         'process_justification_and_finalization',
-        'process_inactivity_updates',  # altair
         'process_rewards_and_penalties',
         'process_registry_updates',
         'process_slashings',
@@ -15,11 +32,7 @@ def get_process_calls(spec):
         'process_slashings_reset',
         'process_randao_mixes_reset',
         'process_historical_roots_update',
-        # phase0 only:
         'process_participation_record_updates',
-        # altair replacement:
-        'process_participation_flag_updates',
-        'process_sync_committee_updates',
     ]
 
 

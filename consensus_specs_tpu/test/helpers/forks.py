@@ -3,7 +3,7 @@
 (reference: test/helpers/constants.py fork-name registry :8-31; the reference
 compares `spec.fork` against those names at helper branch points)
 """
-from ..context import ALTAIR, MERGE, PHASE0
+from ..context import MERGE, PHASE0
 
 
 def is_post_altair(spec) -> bool:

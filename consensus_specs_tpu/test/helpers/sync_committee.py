@@ -4,7 +4,7 @@ Own design for this framework's harness; fills the role of the reference's
 test/helpers/sync_committee.py (aggregate-signature construction :27-45) and
 its reward arithmetic helpers.
 """
-from .keys import privkeys, pubkeys
+from .keys import privkeys
 
 
 def compute_sync_committee_signing_root(spec, state, slot):

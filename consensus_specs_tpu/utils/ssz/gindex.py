@@ -40,6 +40,10 @@ def get_elem_type(typ: Type[View], index_or_field) -> Type[View]:
         from .ssz_typing import uint8
 
         return uint8
+    if issubclass(typ, (Bitvector, Bitlist)):
+        from .ssz_typing import boolean
+
+        return boolean
     raise TypeError(f"cannot index into {typ}")
 
 

@@ -1,0 +1,89 @@
+"""SSZ single Merkle proofs over View objects.
+
+Own design; fills the role of remerkleable's backing-tree proof getters that
+the reference uses for light-client proofs (reference ssz/merkle-proofs.md:
+249-327 for the verification algebra; specs/altair/sync-protocol.md:117-137
+consumes the branches via ``is_valid_merkle_branch``).
+
+``build_proof(view, *path)`` returns the branch (deepest sibling first) for
+the node addressed by ``path``, suitable for
+``is_valid_merkle_branch(leaf, branch, depth, get_subtree_index(gindex), root)``
+with ``gindex = get_generalized_index(type(view), *path)``.
+"""
+from typing import List as PyList
+
+from .gindex import get_generalized_index  # noqa: F401  (API companion)
+from .ssz_typing import (
+    Bitlist, ByteList, Container, List, Vector, View, chunk_count,
+    is_basic_type, next_power_of_two,
+)
+from ..hash_function import hash as sha256
+
+
+def _zero_hashes():
+    from ..merkle_minimal import zerohashes
+
+    return zerohashes
+
+
+def _tree_branch(leaves: PyList[bytes], limit: int, index: int) -> PyList[bytes]:
+    """Branch (deepest-first) for ``leaves[index]`` in the zero-padded binary
+    tree of ``limit`` bottom slots."""
+    zh = _zero_hashes()
+    depth = max(0, (limit - 1).bit_length())
+    layer = list(leaves)
+    branch = []
+    idx = index
+    for d in range(depth):
+        sib = idx ^ 1
+        branch.append(layer[sib] if sib < len(layer) else zh[d])
+        # next layer
+        nxt = []
+        for i in range(0, len(layer), 2):
+            left = layer[i]
+            right = layer[i + 1] if i + 1 < len(layer) else zh[d]
+            nxt.append(sha256(left + right))
+        layer = nxt
+        idx >>= 1
+    return branch
+
+
+def _complex_leaves(view) -> PyList[bytes]:
+    if isinstance(view, Container):
+        return [getattr(view, n).hash_tree_root() for n in view.fields()]
+    # Vector/List of non-basic elements
+    return [e.hash_tree_root() for e in view]
+
+
+def build_proof(view: View, *path) -> PyList[bytes]:
+    """Single-leaf Merkle branch for the node at ``path`` (deepest sibling
+    first, matching ``is_valid_merkle_branch``'s indexing)."""
+    steps = []  # top-down: per-step local branches
+    node = view
+    for p in path:
+        typ = type(node)
+        if issubclass(typ, Container):
+            names = list(typ.fields())
+            pos = names.index(p)
+            leaves = _complex_leaves(node)
+            local = _tree_branch(leaves, next_power_of_two(len(names)), pos)
+            steps.append(local)
+            node = getattr(node, p)
+        elif issubclass(typ, (Vector, List)) and not is_basic_type(typ.ELEM_TYPE):
+            pos = int(p)
+            leaves = _complex_leaves(node)
+            local = _tree_branch(leaves, chunk_count(typ), pos)
+            if issubclass(typ, (List, ByteList, Bitlist)):
+                # length mix-in: the data root's sibling is the length leaf
+                local = local + [len(node).to_bytes(32, "little")]
+            steps.append(local)
+            node = node[pos]
+        else:
+            raise NotImplementedError(
+                f"proofs into {typ.__name__} (packed basic leaves) not supported"
+            )
+    # deepest step's siblings come first
+    out: PyList[bytes] = []
+    for local in reversed(steps):
+        out.extend(local)
+    return out

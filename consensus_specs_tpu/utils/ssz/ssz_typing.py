@@ -816,8 +816,11 @@ class Container(View):
 
     def __eq__(self, other):
         if type(other) is not type(self):
-            if isinstance(other, Container) and other._field_types == self._field_types:
-                pass  # same shape (e.g. fork-specific aliases) — compare by value
+            # same field names (e.g. the same container re-declared in a later
+            # fork's built module) — compare by value; the field TYPES are
+            # distinct classes per built module, so compare names only
+            if isinstance(other, Container) and list(other._field_types) == list(self._field_types):
+                pass
             else:
                 return NotImplemented
         return all(

@@ -1,0 +1,1 @@
+from consensus_specs_tpu.test.altair.epoch_processing.test_process_participation_flag_updates import *  # noqa: F401,F403

@@ -1,0 +1,1 @@
+from consensus_specs_tpu.test.altair.fork.test_upgrade_to_altair import *  # noqa: F401,F403
