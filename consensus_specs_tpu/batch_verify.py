@@ -1,0 +1,198 @@
+"""Batched signature verification plane: collect-then-verify for epoch replay.
+
+The reference verifies signatures one at a time inside the state-transition
+call stack (process_operations loop, reference
+specs/phase0/beacon-chain.md:1742-1756; fork-choice on_attestation,
+fork-choice.md:393-410). On TPU the win comes from batching every independent
+check of a span of blocks into a few device pipelines (SURVEY.md §2.7/P1 —
+the committee axis is the DP axis). This module provides that seam:
+
+  with SignatureCollector(spec) as col:
+      for block in blocks:
+          spec.state_transition(state, block)   # signature checks RECORDED
+  ok = col.flush()                              # ... and verified batched
+  assert ok.all()
+
+What is deferred vs eager — chosen by the spec's own failure semantics:
+
+- DEFERRED (assert-style; a failure invalidates the whole span anyway):
+  aggregate attestation checks (``bls.FastAggregateVerify`` /
+  ``bls.AggregateVerify``, incl. attester slashings and altair's
+  ``eth_fast_aggregate_verify``) and the block proposer signature
+  (``verify_block_signature``).
+- EAGER (oracle, unchanged): ``bls.Verify`` — because ``process_deposit``
+  uses it CONDITIONALLY (an invalid deposit PoP skips the validator instead
+  of failing the block, reference specs/phase0/beacon-chain.md:1871-1887);
+  deferring it optimistically would change the post-state. Randao/exit
+  verifies ride along eagerly; they are K=1 and rare.
+
+``flush()`` runs the recorded checks through the TPU backend's batched entry
+points, grouped by committee-size bucket so a lone 512-wide sync aggregate
+does not pad the whole attestation batch. Bit-identical to the per-call
+oracle (cross-checked in tests/test_batch_verify.py). If any check fails,
+the span is invalid — the caller re-runs with per-call verification to
+locate the offending block (the reference's always-sequential slow path).
+"""
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from .utils import bls
+
+
+class CollectedCheck:
+    __slots__ = ("kind", "pubkeys", "messages", "signature")
+
+    def __init__(self, kind: str, pubkeys, messages, signature):
+        self.kind = kind  # "fast_aggregate" | "aggregate"
+        self.pubkeys = pubkeys
+        self.messages = messages  # one message (fast_aggregate) or per-key list
+        self.signature = signature
+
+
+class SignatureCollector:
+    """Context manager recording the spec's assert-style BLS verifications,
+    answering True during collection; ``flush()`` verifies them batched."""
+
+    def __init__(self, spec=None):
+        self.spec = spec
+        self.checks: List[CollectedCheck] = []
+        # captured eagerly so flush_oracle() resolves through the REAL
+        # functions even while the context is active (looking bls.X up at
+        # call time inside the context would hit the interceptor and loop)
+        self._orig_fast_aggregate_verify = bls.FastAggregateVerify
+        self._orig_aggregate_verify = bls.AggregateVerify
+        self._saved_bls: Tuple = ()
+        self._saved_vbs = None
+
+    # -- switchboard interception ------------------------------------------
+
+    def _fast_aggregate_verify(self, pubkeys, message, signature):
+        if not bls.bls_active:
+            # stub mode (--disable-bls test runs): blocks carry stub
+            # signatures that must NOT reach real crypto at flush time;
+            # mirror only_with_bls's stub answer and record nothing
+            return True
+        if len(pubkeys) == 0:
+            # the reference returns False without any crypto; preserve that
+            # exactly rather than deferring (reference utils/bls.py:67-74)
+            return False
+        self.checks.append(
+            CollectedCheck(
+                "fast_aggregate",
+                [bytes(pk) for pk in pubkeys],
+                bytes(message),
+                bytes(signature),
+            )
+        )
+        return True
+
+    def _aggregate_verify(self, pubkeys, messages, signature):
+        if not bls.bls_active:
+            return True
+        if len(pubkeys) == 0 or len(pubkeys) != len(messages):
+            return False
+        self.checks.append(
+            CollectedCheck(
+                "aggregate",
+                [bytes(pk) for pk in pubkeys],
+                [bytes(m) for m in messages],
+                bytes(signature),
+            )
+        )
+        return True
+
+    def _verify_block_signature(self, state, signed_block):
+        if not bls.bls_active:
+            return True
+        spec = self.spec
+        proposer = state.validators[signed_block.message.proposer_index]
+        signing_root = spec.compute_signing_root(
+            signed_block.message,
+            spec.get_domain(state, spec.DOMAIN_BEACON_PROPOSER),
+        )
+        self.checks.append(
+            CollectedCheck(
+                "fast_aggregate",
+                [bytes(proposer.pubkey)],
+                bytes(signing_root),
+                bytes(signed_block.signature),
+            )
+        )
+        return True
+
+    def __enter__(self):
+        self._saved_bls = (bls.FastAggregateVerify, bls.AggregateVerify)
+        bls.FastAggregateVerify = self._fast_aggregate_verify
+        bls.AggregateVerify = self._aggregate_verify
+        if self.spec is not None and hasattr(self.spec, "verify_block_signature"):
+            self._saved_vbs = self.spec.verify_block_signature
+            self.spec.verify_block_signature = self._verify_block_signature
+        return self
+
+    def __exit__(self, *exc):
+        bls.FastAggregateVerify, bls.AggregateVerify = self._saved_bls
+        if self._saved_vbs is not None:
+            self.spec.verify_block_signature = self._saved_vbs
+            self._saved_vbs = None
+        return False
+
+    # -- batched resolution -------------------------------------------------
+
+    def flush(self, backend=None) -> np.ndarray:
+        """Verify all recorded checks; returns a bool array in record order.
+
+        Checks are grouped by (kind, K-bucket) so each device batch pads to
+        its own committee-size bucket (ops/bls_backend.py _K_BUCKETS)."""
+        if backend is None:
+            from .ops import bls_backend as backend  # noqa: F811
+
+        out = np.zeros(len(self.checks), dtype=bool)
+        groups = {}
+        for i, c in enumerate(self.checks):
+            key = (c.kind, _bucket_of(len(c.pubkeys)))
+            groups.setdefault(key, []).append(i)
+
+        for (kind, _bucket), idxs in groups.items():
+            if kind == "fast_aggregate":
+                res = backend.batch_fast_aggregate_verify(
+                    [self.checks[i].pubkeys for i in idxs],
+                    [self.checks[i].messages for i in idxs],
+                    [self.checks[i].signature for i in idxs],
+                )
+            else:
+                res = backend.batch_aggregate_verify(
+                    [self.checks[i].pubkeys for i in idxs],
+                    [self.checks[i].messages for i in idxs],
+                    [self.checks[i].signature for i in idxs],
+                )
+            for j, i in enumerate(idxs):
+                out[i] = bool(res[j])
+        return out
+
+    def flush_oracle(self) -> np.ndarray:
+        """Sequential pure-Python resolution of the same checks (the
+        reference's execution model) — the cross-check for flush()."""
+        out = np.zeros(len(self.checks), dtype=bool)
+        for i, c in enumerate(self.checks):
+            if c.kind == "fast_aggregate":
+                out[i] = self._orig_fast_aggregate_verify(c.pubkeys, c.messages, c.signature)
+            else:
+                out[i] = self._orig_aggregate_verify(c.pubkeys, c.messages, c.signature)
+        return out
+
+
+def _bucket_of(k: int) -> int:
+    from .ops.bls_backend import _k_bucket
+
+    return _k_bucket(max(1, k))
+
+
+def replay_blocks_batched(spec, state, signed_blocks: Sequence) -> np.ndarray:
+    """Replay ``signed_blocks`` through ``spec.state_transition`` with all
+    assert-style signature checks collected, then batch-verified. Mutates
+    ``state``. Returns the per-check result array (all True = valid span)."""
+    with SignatureCollector(spec) as col:
+        for signed_block in signed_blocks:
+            spec.state_transition(state, signed_block)
+    return col.flush()

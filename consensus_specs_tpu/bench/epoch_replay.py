@@ -1,0 +1,115 @@
+"""Epoch-replay benchmark: a mainnet-shaped epoch of signature checks
+through the batched device pipeline (BASELINE config #4).
+
+Workload shape (reference protocol constants, BASELINE.md):
+  SLOTS x COMMITTEES FastAggregateVerify items of K_att signers each
+  (the process_attestation hot loop, reference
+  specs/phase0/beacon-chain.md:1742-1756, :719-735),
+  + SLOTS sync-aggregate verifies of K_sync=512
+  (altair process_sync_aggregate, specs/altair/beacon-chain.md:535-565),
+  + SLOTS block-proposer verifies of K=1
+  (verify_block_signature, specs/phase0/beacon-chain.md:1253-1258).
+
+Mainnet defaults 32 x 64 x 146 cover ~300k attesting validators. Setup cost
+is kept linear in the number of CHECKS, not signatures: an aggregate of
+same-message signatures from keys {sk_i} equals Sign(sum sk_i mod r), so
+each committee costs one G2 multiply to construct.
+
+Env: BENCH_EPOCH_SLOTS, BENCH_EPOCH_COMMITTEES, BENCH_EPOCH_K,
+BENCH_EPOCH_POOL (pubkey pool size), BENCH_REPS.
+"""
+import os
+import time
+
+from ..batch_verify import SignatureCollector
+from ..utils import bls
+from ..utils.bls12_381 import R
+
+TARGET_PER_CHIP = 150_000 / 8
+
+
+def _env_int(name, default):
+    return int(os.environ.get(name, str(default)))
+
+
+def build_epoch_checks(slots, committees, k_att, k_sync, pool_size):
+    """Synthesize the epoch's checks into a SignatureCollector (as if a
+    32-block replay had just been collected)."""
+    pool_size = max(pool_size, k_att, k_sync)
+    privkeys = list(range(1, pool_size + 1))
+    pubkeys = [bls.SkToPk(sk) for sk in privkeys]
+
+    col = SignatureCollector()
+    for slot in range(slots):
+        # attestation committees: distinct message per (slot, committee)
+        for c in range(committees):
+            start = (slot * committees + c) % (pool_size - k_att + 1)
+            ks = privkeys[start:start + k_att]
+            pks = pubkeys[start:start + k_att]
+            msg = b"att" + slot.to_bytes(8, "little") + c.to_bytes(8, "little") + b"\x00" * 13
+            agg_sk = sum(ks) % R
+            sig = bls.Sign(agg_sk, msg)
+            col._fast_aggregate_verify(pks, msg, sig)
+        # one sync aggregate per slot
+        if k_sync > 0:
+            ks = privkeys[:k_sync]
+            msg = b"sync" + slot.to_bytes(8, "little") + b"\x00" * 20
+            sig = bls.Sign(sum(ks) % R, msg)
+            col._fast_aggregate_verify(pubkeys[:k_sync], msg, sig)
+        # one proposer signature per slot
+        proposer = slot % pool_size
+        msg = b"blk" + slot.to_bytes(8, "little") + b"\x00" * 21
+        col._fast_aggregate_verify(
+            [pubkeys[proposer]], msg, bls.Sign(privkeys[proposer], msg)
+        )
+    return col
+
+
+def run_epoch_replay() -> dict:
+    import jax
+
+    platform = jax.default_backend()
+    on_cpu = platform == "cpu"
+
+    # CPU fallback keeps the epoch SHAPE but shrinks the axes so a number
+    # still lands within the bench deadline; the TPU run uses mainnet scale
+    slots = _env_int("BENCH_EPOCH_SLOTS", 2 if on_cpu else 32)
+    committees = _env_int("BENCH_EPOCH_COMMITTEES", 2 if on_cpu else 64)
+    k_att = _env_int("BENCH_EPOCH_K", 8 if on_cpu else 146)
+    k_sync = _env_int("BENCH_EPOCH_K_SYNC", 16 if on_cpu else 512)
+    pool = _env_int("BENCH_EPOCH_POOL", max(k_att, k_sync))
+    reps = _env_int("BENCH_REPS", 2)
+
+    t0 = time.perf_counter()
+    col = build_epoch_checks(slots, committees, k_att, k_sync, pool)
+    setup_s = time.perf_counter() - t0
+
+    n_sigs = slots * (committees * k_att + k_sync + 1)
+
+    # warmup compile of each bucket
+    ok = col.flush()
+    assert ok.all(), "epoch warmup verification failed"
+
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        ok = col.flush()
+        dt = time.perf_counter() - t0
+        assert ok.all(), "epoch verification failed"
+        times.append(dt)
+    times.sort()
+    best = times[len(times) // 2]
+
+    sigs_per_sec = n_sigs / best
+    return dict(
+        value=sigs_per_sec,
+        vs_baseline=sigs_per_sec / TARGET_PER_CHIP,
+        platform=platform,
+        mode="epoch",
+        slots=slots,
+        committees=committees,
+        k=k_att,
+        signatures=n_sigs,
+        epoch_seconds=round(best, 3),
+        setup_seconds=round(setup_s, 1),
+    )

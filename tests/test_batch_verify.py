@@ -1,0 +1,123 @@
+"""Collect-then-batch-verify plane vs the reference's sequential model
+(consensus_specs_tpu/batch_verify.py; hot loop reference
+specs/phase0/beacon-chain.md:1742-1756)."""
+import numpy as np
+import pytest
+
+from consensus_specs_tpu.batch_verify import SignatureCollector, replay_blocks_batched
+from consensus_specs_tpu.utils import bls
+
+
+def _mk_check(col, k, msg, corrupt=False):
+    sks = list(range(1, k + 1))
+    pks = [bls.SkToPk(sk) for sk in sks]
+    sig = bls.Aggregate([bls.Sign(sk, msg) for sk in sks])
+    if corrupt:
+        msg = b"X" + msg[1:]
+    col._fast_aggregate_verify(pks, msg, sig)
+
+
+def test_collector_records_and_answers_true():
+    with SignatureCollector() as col:
+        assert bls.FastAggregateVerify([b"\x01" * 48], b"\x02" * 32, b"\x03" * 96)
+        assert not bls.FastAggregateVerify([], b"\x02" * 32, b"\x03" * 96)  # empty: eager False
+        assert not bls.AggregateVerify([b"\x01" * 48], [], b"\x03" * 96)  # mismatch: eager False
+    # interception removed on exit
+    assert bls.FastAggregateVerify.__name__ != "_fast_aggregate_verify"
+    assert len(col.checks) == 1
+
+
+def test_flush_matches_oracle_small():
+    col = SignatureCollector()
+    _mk_check(col, 2, b"m1" + b"\x00" * 30)
+    _mk_check(col, 3, b"m2" + b"\x00" * 30)
+    _mk_check(col, 2, b"m3" + b"\x00" * 30, corrupt=True)  # must fail
+    got = col.flush()
+    want = col.flush_oracle()
+    assert np.array_equal(got, want)
+    assert list(want) == [True, True, False]
+
+
+@pytest.mark.slow
+def test_epoch_replay_batched_matches_sequential():
+    """Replay two slots of real blocks-with-attestations twice: once with
+    per-call oracle verification (the reference model), once collected +
+    batch-verified; post-states and check results must agree."""
+    from consensus_specs_tpu.test.context import build_spec_module
+    from consensus_specs_tpu.test.helpers.genesis import create_genesis_state
+    from consensus_specs_tpu.test.helpers.state import next_epoch
+    from consensus_specs_tpu.test.helpers.attestations import (
+        next_slots_with_attestations,
+    )
+
+    spec = build_spec_module("phase0", "minimal")
+    bls.bls_active = True
+    try:
+        state = create_genesis_state(
+            spec, [spec.MAX_EFFECTIVE_BALANCE] * 64, spec.MAX_EFFECTIVE_BALANCE
+        )
+        next_epoch(spec, state)
+        base = state.copy()
+        # build two slots of blocks carrying real signed attestations
+        _, signed_blocks, post_sequential = next_slots_with_attestations(
+            spec, state, 2, True, False
+        )
+
+        # batched replay from the same base
+        replay_state = base.copy()
+        ok = replay_blocks_batched(spec, replay_state, signed_blocks)
+        assert ok.all()
+        # block sigs + one attestation per block from slot 2 onward
+        assert len(ok) >= len(signed_blocks)
+        assert spec.hash_tree_root(replay_state) == spec.hash_tree_root(post_sequential)
+    finally:
+        bls.bls_active = True
+
+
+@pytest.mark.slow
+def test_epoch_replay_detects_corruption():
+    from consensus_specs_tpu.test.context import build_spec_module
+    from consensus_specs_tpu.test.helpers.genesis import create_genesis_state
+    from consensus_specs_tpu.test.helpers.state import next_epoch
+    from consensus_specs_tpu.test.helpers.attestations import (
+        next_slots_with_attestations,
+    )
+
+    spec = build_spec_module("phase0", "minimal")
+    bls.bls_active = True
+    state = create_genesis_state(
+        spec, [spec.MAX_EFFECTIVE_BALANCE] * 64, spec.MAX_EFFECTIVE_BALANCE
+    )
+    next_epoch(spec, state)
+    base = state.copy()
+    _, signed_blocks, _ = next_slots_with_attestations(spec, state, 2, True, False)
+
+    # corrupt one attestation signature in the last block; the signature is
+    # not part of the state, but the block root changes, so recompute the
+    # block's state root (with stub BLS — the corruption must only surface
+    # at flush time) and re-sign the block itself
+    from consensus_specs_tpu.test.helpers.block import sign_block
+
+    bad = signed_blocks[-1].message.copy()
+    assert len(bad.body.attestations) > 0
+    bad.body.attestations[0].signature = spec.BLSSignature(b"\xaa" + b"\x00" * 95)
+
+    scratch = base.copy()
+    bls.bls_active = False
+    for sb in signed_blocks[:-1]:
+        spec.state_transition(scratch, sb)
+    bad.state_root = spec.compute_new_state_root(scratch, bad)
+    bls.bls_active = True
+    resigned = sign_block(spec, scratch, bad)
+
+    replay_state = base.copy()
+    ok = replay_blocks_batched(
+        spec, replay_state, list(signed_blocks[:-1]) + [resigned]
+    )
+    assert not ok.all()
+    # re-resolve the same checks sequentially: identical verdicts
+    with SignatureCollector(spec) as col2:
+        state2 = base.copy()
+        for sb in list(signed_blocks[:-1]) + [resigned]:
+            spec.state_transition(state2, sb)
+    assert np.array_equal(ok, col2.flush_oracle())
