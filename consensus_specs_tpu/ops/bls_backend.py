@@ -191,12 +191,12 @@ def _easy_part_flat(f_coeffs: List[int]) -> Optional[List[int]]:
     return _oracle_to_flat_ints(g)
 
 
-def _run_hard_part(g_flat_batch: np.ndarray) -> np.ndarray:
+def _run_hard_part(g_flat_batch: np.ndarray, mesh=None) -> np.ndarray:
     """(N, 12, L) unitary g limb batch -> (N,) bool (res == 1)."""
     n = g_flat_batch.shape[0]
     prB = _program("hard_part")
     ins = {f"g.{i}": g_flat_batch[:, i] for i in range(12)}
-    out = vm.execute(prB, ins, batch_shape=(n,))
+    out = vm.execute(prB, ins, batch_shape=(n,), mesh=mesh)
     ok = np.zeros(n, dtype=bool)
     for i in range(n):
         res = [fq.from_mont_limbs(out[f"res.{j}"][i]) for j in range(12)]
@@ -213,10 +213,12 @@ def batch_fast_aggregate_verify(
     pubkey_sets: Sequence[Sequence[bytes]],
     messages: Sequence[bytes],
     signatures: Sequence[bytes],
+    mesh=None,
 ) -> np.ndarray:
     """N independent FastAggregateVerify calls in one device pipeline.
     This is the TPU mapping of the reference's per-attestation verify loop
-    (reference specs/phase0/beacon-chain.md:1742-1756, :719-735)."""
+    (reference specs/phase0/beacon-chain.md:1742-1756, :719-735).
+    With ``mesh``, the batch axis is sharded over its first mesh axis."""
     n = len(pubkey_sets)
     assert len(messages) == n and len(signatures) == n
     if n == 0:
@@ -224,6 +226,8 @@ def batch_fast_aggregate_verify(
     max_k = max((len(pks) for pks in pubkey_sets), default=1)
     k = _k_bucket(max(1, max_k))
     nb = _pow2(n)
+    if mesh is not None:
+        nb = max(nb, int(np.prod(list(mesh.shape.values()))))
     L = fq.NUM_LIMBS
 
     prA = _program("miller_product", k)
@@ -257,7 +261,7 @@ def batch_fast_aggregate_verify(
     if not precheck.any():
         return precheck[:n]
 
-    out = vm.execute(prA, ins, batch_shape=(nb,))
+    out = vm.execute(prA, ins, batch_shape=(nb,), mesh=mesh)
 
     agg_nonzero = np.zeros(nb, dtype=bool)
     g_batch = np.zeros((nb, 12, L), dtype=np.uint64)
@@ -274,7 +278,7 @@ def batch_fast_aggregate_verify(
         for j in range(12):
             g_batch[i, j] = fq.to_mont_int(g[j])
 
-    ok = _run_hard_part(g_batch)
+    ok = _run_hard_part(g_batch, mesh=mesh)
     return (ok & precheck & agg_nonzero)[:n]
 
 
@@ -282,10 +286,12 @@ def batch_aggregate_verify(
     pubkey_lists: Sequence[Sequence[bytes]],
     message_lists: Sequence[Sequence[bytes]],
     signatures: Sequence[bytes],
+    mesh=None,
 ) -> np.ndarray:
     """N independent AggregateVerify calls (distinct messages per pubkey).
     Inactive pair lanes use infinity G1 (their Miller factor lands in a
-    proper subfield, killed by the final exponentiation)."""
+    proper subfield, killed by the final exponentiation).
+    With ``mesh``, the batch axis is sharded over its first mesh axis."""
     n = len(pubkey_lists)
     if n == 0:
         return np.zeros(0, dtype=bool)
@@ -294,6 +300,8 @@ def batch_aggregate_verify(
     )
     k = _k_bucket(max(1, max_k))
     nb = _pow2(n)
+    if mesh is not None:
+        nb = max(nb, int(np.prod(list(mesh.shape.values()))))
     L = fq.NUM_LIMBS
 
     prA = _program("aggregate_verify", k)
@@ -330,7 +338,7 @@ def batch_aggregate_verify(
     if not precheck.any():
         return precheck[:n]
 
-    out = vm.execute(prA, ins, batch_shape=(nb,))
+    out = vm.execute(prA, ins, batch_shape=(nb,), mesh=mesh)
     g_batch = np.zeros((nb, 12, L), dtype=np.uint64)
     for i in range(nb):
         if not precheck[i]:
@@ -342,7 +350,7 @@ def batch_aggregate_verify(
             continue
         for j in range(12):
             g_batch[i, j] = fq.to_mont_int(g[j])
-    ok = _run_hard_part(g_batch)
+    ok = _run_hard_part(g_batch, mesh=mesh)
     return (ok & precheck)[:n]
 
 

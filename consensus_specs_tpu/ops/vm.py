@@ -360,14 +360,49 @@ def _vm_run(regs, instr_arrays):
     return regs
 
 
-def execute(program: Program, inputs: Dict[str, np.ndarray], batch_shape=()) -> Dict[str, np.ndarray]:
+import functools as _functools
+
+
+@_functools.lru_cache(maxsize=8)
+def _vm_run_for_mesh(mesh):
+    """Jitted VM runner with the leading batch axis sharded over ``mesh``
+    (the DP axis of SURVEY.md §2.7/P1) and the instruction stream replicated.
+    The scan body is purely batch-elementwise, so GSPMD partitions it with
+    zero collectives — each device runs its slice of the verification batch."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    axis = mesh.axis_names[0]
+    batch_sh = NamedSharding(mesh, P(axis))
+    repl = NamedSharding(mesh, P())
+    return jax.jit(
+        lambda regs, instr: jax.lax.scan(_vm_step, regs, instr)[0],
+        in_shardings=(batch_sh, tuple(repl for _ in range(7))),
+        out_shardings=batch_sh,
+    )
+
+
+def execute(program: Program, inputs: Dict[str, np.ndarray], batch_shape=(),
+            mesh=None) -> Dict[str, np.ndarray]:
     """Run an assembled program. Input arrays must be Montgomery limb arrays
     of shape batch_shape + (NUM_LIMBS,). Returns named outputs (loose,
-    bounded < 2^382)."""
+    bounded < 2^382). With ``mesh``, the leading batch axis is sharded over
+    the mesh's first axis (batch_shape[0] must divide by its size)."""
     regs = program.init_regs(tuple(batch_shape))
     regs = program.load_inputs(regs, inputs)
     instr = tuple(jnp.asarray(x) for x in program.instr)
-    out = _vm_run(jnp.asarray(regs), instr)
+    if mesh is None:
+        out = _vm_run(jnp.asarray(regs), instr)
+    else:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        axis = mesh.axis_names[0]
+        regs_d = jax.device_put(
+            jnp.asarray(regs), NamedSharding(mesh, P(axis))
+        )
+        instr_d = tuple(
+            jax.device_put(x, NamedSharding(mesh, P())) for x in instr
+        )
+        out = _vm_run_for_mesh(mesh)(regs_d, instr_d)
     out = np.asarray(out)
     return {
         name: out[..., int(reg), :]
