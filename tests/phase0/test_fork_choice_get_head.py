@@ -1,0 +1,1 @@
+from consensus_specs_tpu.test.phase0.fork_choice.test_get_head import *  # noqa: F401,F403
