@@ -1,0 +1,130 @@
+"""Rewards-delta tests over the checking engine (helpers/rewards.py)
+(spec: reference specs/phase0/beacon-chain.md:1463-1560,
+specs/altair/beacon-chain.md:364-407; scenario coverage modeled on the
+reference's rewards test tree, written for this harness)."""
+from random import Random
+
+from ...context import (
+    low_balances, misc_balances, spec_state_test, spec_test,
+    with_all_phases, with_custom_state, default_activation_threshold,
+    zero_activation_threshold,
+)
+from ...helpers.attestations import next_epoch_with_attestations
+from ...helpers.rewards import run_deltas, run_deltas_at_boundary
+from ...helpers.state import next_epoch
+
+
+def _attested_state(spec, state, participation_fn=None):
+    """One epoch of real attesting blocks, landing at the next epoch start
+    (previous-epoch attestations / participation flags populated)."""
+    next_epoch(spec, state)
+    _, _, post = next_epoch_with_attestations(
+        spec, state, True, False, participation_fn=participation_fn
+    )
+    return post
+
+
+@with_all_phases
+@spec_state_test
+def test_empty_attestations(spec, state):
+    # nobody attested last epoch: every eligible validator is penalized on
+    # source/target/head (phase0) or every flag (altair); no rewards
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    yield from run_deltas_at_boundary(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_full_attestations(spec, state):
+    state = _attested_state(spec, state)
+    yield from run_deltas_at_boundary(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_half_attestations(spec, state):
+    def half(slot, index, committee):
+        members = sorted(committee)
+        return set(members[: max(1, len(members) // 2)])
+
+    state = _attested_state(spec, state, participation_fn=half)
+    yield from run_deltas_at_boundary(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_random_attestations(spec, state):
+    rng = Random(3456)
+
+    def sample(slot, index, committee):
+        return set(v for v in committee if rng.random() < 0.7)
+
+    state = _attested_state(spec, state, participation_fn=sample)
+    yield from run_deltas_at_boundary(spec, state)
+
+
+@with_all_phases
+@spec_test
+@with_custom_state(misc_balances, default_activation_threshold)
+def test_full_attestations_misc_balances(spec, state):
+    state = _attested_state(spec, state)
+    yield from run_deltas_at_boundary(spec, state)
+
+
+@with_all_phases
+@spec_test
+@with_custom_state(low_balances, zero_activation_threshold)
+def test_full_attestations_low_balances(spec, state):
+    state = _attested_state(spec, state)
+    yield from run_deltas_at_boundary(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_slashed_validators_penalized(spec, state):
+    state = _attested_state(spec, state)
+    # slash a few attesters after the fact: they are excluded from the
+    # unslashed sets and penalized like absentees
+    for index in list(spec.get_active_validator_indices(
+        state, spec.get_current_epoch(state)
+    ))[:3]:
+        spec.slash_validator(state, index)
+    yield from run_deltas_at_boundary(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_inactivity_leak(spec, state):
+    # stall finality long enough to trip the leak
+    # (MIN_EPOCHS_TO_INACTIVITY_PENALTY, beacon-chain.md:1527-1546)
+    for _ in range(int(spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY) + 2):
+        next_epoch(spec, state)
+    if hasattr(spec, "process_inactivity_updates"):
+        # altair: give the inactivity scores something to bite on
+        state.inactivity_scores = [
+            spec.uint64(5 * int(spec.config.INACTIVITY_SCORE_BIAS))
+        ] * len(state.validators)
+    from ...helpers.rewards import prepare_rewards_state
+
+    prepare_rewards_state(spec, state)
+    assert spec.is_in_inactivity_leak(state)
+    yield from run_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_leak_with_half_participation(spec, state):
+    def half(slot, index, committee):
+        members = sorted(committee)
+        return set(members[: max(1, len(members) // 2)])
+
+    for _ in range(int(spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY) + 2):
+        next_epoch(spec, state)
+    _, _, state = next_epoch_with_attestations(
+        spec, state, True, False, participation_fn=half
+    )
+    from ...helpers.rewards import prepare_rewards_state
+
+    prepare_rewards_state(spec, state)
+    yield from run_deltas(spec, state)
