@@ -27,7 +27,7 @@ SPEC_SRC_DIR = Path(__file__).resolve().parent / "specsrc"
 FORK_ORDER = ["phase0", "altair", "merge"]
 
 # forks with authored spec sources; extended as forks land
-IMPLEMENTED_FORKS = ["phase0", "altair"]
+IMPLEMENTED_FORKS = ["phase0", "altair", "merge"]
 
 SOURCES = {
     "phase0": [

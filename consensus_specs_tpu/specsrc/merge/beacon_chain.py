@@ -1,0 +1,310 @@
+# The Merge — The Beacon Chain (executable spec source)
+#
+# Provenance: function bodies transcribed from the spec text (reference
+# specs/merge/beacon-chain.md) — conformance requires identical semantics.
+# Exec'd after phase0 + altair sources into the same namespace; definitions
+# here override theirs (reference combine_spec_objects, setup.py:722-745).
+# The ExecutionEngine protocol stub + EXECUTION_ENGINE global mirror the
+# sundries the reference injects at build time (setup.py:509-540).
+
+# ---------------------------------------------------------------------------
+# custom types + constants (merge/beacon-chain.md:47-76)
+# ---------------------------------------------------------------------------
+
+# preset: MAX_BYTES_PER_TRANSACTION, MAX_TRANSACTIONS_PER_PAYLOAD,
+# BYTES_PER_LOGS_BLOOM, MAX_EXTRA_DATA_BYTES (presets/*/merge.yaml)
+Transaction = ByteList[MAX_BYTES_PER_TRANSACTION]
+
+
+class ExecutionAddress(Bytes20):
+    pass
+
+
+# GAS_LIMIT_DENOMINATOR / MIN_GAS_LIMIT come from the preset
+# (presets/*/merge.yaml, reference presets/minimal/merge.yaml:11-14)
+
+
+# ---------------------------------------------------------------------------
+# containers (merge/beacon-chain.md:79-188)
+# ---------------------------------------------------------------------------
+
+class ExecutionPayload(Container):
+    # Execution block header fields
+    parent_hash: Hash32
+    coinbase: ExecutionAddress  # 'beneficiary' in the yellow paper
+    state_root: Bytes32
+    receipt_root: Bytes32  # 'receipts root' in the yellow paper
+    logs_bloom: ByteVector[BYTES_PER_LOGS_BLOOM]
+    random: Bytes32  # 'difficulty' in the yellow paper
+    block_number: uint64  # 'number' in the yellow paper
+    gas_limit: uint64
+    gas_used: uint64
+    timestamp: uint64
+    extra_data: ByteList[MAX_EXTRA_DATA_BYTES]
+    base_fee_per_gas: uint256
+    # Extra payload fields
+    block_hash: Hash32  # Hash of execution block
+    transactions: List[Transaction, MAX_TRANSACTIONS_PER_PAYLOAD]
+
+
+class ExecutionPayloadHeader(Container):
+    # Execution block header fields
+    parent_hash: Hash32
+    coinbase: ExecutionAddress
+    state_root: Bytes32
+    receipt_root: Bytes32
+    logs_bloom: ByteVector[BYTES_PER_LOGS_BLOOM]
+    random: Bytes32
+    block_number: uint64
+    gas_limit: uint64
+    gas_used: uint64
+    timestamp: uint64
+    extra_data: ByteList[MAX_EXTRA_DATA_BYTES]
+    base_fee_per_gas: uint256
+    # Extra payload fields
+    block_hash: Hash32  # Hash of execution block
+    transactions_root: Root
+
+
+class BeaconBlockBody(Container):
+    randao_reveal: BLSSignature
+    eth1_data: Eth1Data  # Eth1 data vote
+    graffiti: Bytes32  # Arbitrary data
+    # Operations
+    proposer_slashings: List[ProposerSlashing, MAX_PROPOSER_SLASHINGS]
+    attester_slashings: List[AttesterSlashing, MAX_ATTESTER_SLASHINGS]
+    attestations: List[Attestation, MAX_ATTESTATIONS]
+    deposits: List[Deposit, MAX_DEPOSITS]
+    voluntary_exits: List[SignedVoluntaryExit, MAX_VOLUNTARY_EXITS]
+    sync_aggregate: SyncAggregate
+    # Execution
+    execution_payload: ExecutionPayload  # [New in Merge]
+
+
+class BeaconBlock(Container):
+    slot: Slot
+    proposer_index: ValidatorIndex
+    parent_root: Root
+    state_root: Root
+    body: BeaconBlockBody
+
+
+class SignedBeaconBlock(Container):
+    message: BeaconBlock
+    signature: BLSSignature
+
+
+class BeaconState(Container):
+    # Versioning
+    genesis_time: uint64
+    genesis_validators_root: Root
+    slot: Slot
+    fork: Fork
+    # History
+    latest_block_header: BeaconBlockHeader
+    block_roots: Vector[Root, SLOTS_PER_HISTORICAL_ROOT]
+    state_roots: Vector[Root, SLOTS_PER_HISTORICAL_ROOT]
+    historical_roots: List[Root, HISTORICAL_ROOTS_LIMIT]
+    # Eth1
+    eth1_data: Eth1Data
+    eth1_data_votes: List[Eth1Data, EPOCHS_PER_ETH1_VOTING_PERIOD * SLOTS_PER_EPOCH]
+    eth1_deposit_index: uint64
+    # Registry
+    validators: List[Validator, VALIDATOR_REGISTRY_LIMIT]
+    balances: List[Gwei, VALIDATOR_REGISTRY_LIMIT]
+    # Randomness
+    randao_mixes: Vector[Bytes32, EPOCHS_PER_HISTORICAL_VECTOR]
+    # Slashings
+    slashings: Vector[Gwei, EPOCHS_PER_SLASHINGS_VECTOR]  # Per-epoch sums of slashed effective balances
+    # Participation
+    previous_epoch_participation: List[ParticipationFlags, VALIDATOR_REGISTRY_LIMIT]
+    current_epoch_participation: List[ParticipationFlags, VALIDATOR_REGISTRY_LIMIT]
+    # Finality
+    justification_bits: Bitvector[JUSTIFICATION_BITS_LENGTH]  # Bit set for every recent justified epoch
+    previous_justified_checkpoint: Checkpoint
+    current_justified_checkpoint: Checkpoint
+    finalized_checkpoint: Checkpoint
+    # Inactivity
+    inactivity_scores: List[uint64, VALIDATOR_REGISTRY_LIMIT]
+    # Sync
+    current_sync_committee: SyncCommittee
+    next_sync_committee: SyncCommittee
+    # Execution
+    latest_execution_payload_header: ExecutionPayloadHeader  # [New in Merge]
+
+
+# ---------------------------------------------------------------------------
+# predicates + misc (merge/beacon-chain.md:193-226)
+# ---------------------------------------------------------------------------
+
+def is_merge_complete(state: BeaconState) -> bool:
+    # (merge/beacon-chain.md:193-199)
+    return state.latest_execution_payload_header != ExecutionPayloadHeader()
+
+
+def is_merge_block(state: BeaconState, body: BeaconBlockBody) -> bool:
+    # (merge/beacon-chain.md:201-206)
+    return not is_merge_complete(state) and body.execution_payload != ExecutionPayload()
+
+
+def is_execution_enabled(state: BeaconState, body: BeaconBlockBody) -> bool:
+    # (merge/beacon-chain.md:208-213)
+    return is_merge_block(state, body) or is_merge_complete(state)
+
+
+def compute_timestamp_at_slot(state: BeaconState, slot: Slot) -> uint64:
+    # (merge/beacon-chain.md:216-224)
+    slots_since_genesis = slot - GENESIS_SLOT
+    return uint64(state.genesis_time + slots_since_genesis * config.SECONDS_PER_SLOT)
+
+
+# ---------------------------------------------------------------------------
+# execution engine (merge/beacon-chain.md:228-249; testing stub mirrors
+# reference setup.py:525-540)
+# ---------------------------------------------------------------------------
+
+class NoopExecutionEngine:
+    """Implementation-dependent ExecutionEngine protocol; the spec's testing
+    stub accepts every payload and cannot produce one."""
+
+    def execute_payload(self, execution_payload: ExecutionPayload) -> bool:
+        return True
+
+    def notify_forkchoice_updated(self, head_block_hash: Hash32,
+                                  finalized_block_hash: Hash32,
+                                  payload_attributes=None) -> None:
+        pass
+
+    def get_payload(self, payload_id) -> ExecutionPayload:
+        raise NotImplementedError("no payload available from the no-op engine")
+
+
+ExecutionEngine = NoopExecutionEngine  # protocol alias for annotations
+EXECUTION_ENGINE = NoopExecutionEngine()
+
+
+# ---------------------------------------------------------------------------
+# block processing (merge/beacon-chain.md:253-324)
+# ---------------------------------------------------------------------------
+
+def process_block(state: BeaconState, block: BeaconBlock) -> None:
+    # (merge/beacon-chain.md:255-269 — the payload is processed BEFORE
+    # randao because it consumes the previous block's mix)
+    process_block_header(state, block)
+    if is_execution_enabled(state, block.body):
+        process_execution_payload(state, block.body.execution_payload, EXECUTION_ENGINE)  # [New in Merge]
+    process_randao(state, block.body)
+    process_eth1_data(state, block.body)
+    process_operations(state, block.body)
+    process_sync_aggregate(state, block.body.sync_aggregate)
+
+
+def is_valid_gas_limit(payload: ExecutionPayload, parent: ExecutionPayloadHeader) -> bool:
+    # (merge/beacon-chain.md:273-288)
+    parent_gas_limit = parent.gas_limit
+
+    # Check if the payload used too much gas
+    if payload.gas_used > payload.gas_limit:
+        return False
+
+    # Check if the payload changed the gas limit too much
+    if payload.gas_limit >= parent_gas_limit + parent_gas_limit // GAS_LIMIT_DENOMINATOR:
+        return False
+    if payload.gas_limit <= parent_gas_limit - parent_gas_limit // GAS_LIMIT_DENOMINATOR:
+        return False
+
+    # Check if the gas limit is at least the minimum gas limit
+    if payload.gas_limit < MIN_GAS_LIMIT:
+        return False
+
+    return True
+
+
+def process_execution_payload(state: BeaconState, payload: ExecutionPayload,
+                              execution_engine: ExecutionEngine) -> None:
+    # (merge/beacon-chain.md:290-324)
+    # Verify consistency of the parent hash, block number and gas limit
+    # with respect to the previous execution payload header
+    if is_merge_complete(state):
+        assert payload.parent_hash == state.latest_execution_payload_header.block_hash
+        assert payload.block_number == state.latest_execution_payload_header.block_number + uint64(1)
+        assert is_valid_gas_limit(payload, state.latest_execution_payload_header)
+    # Verify random
+    assert payload.random == get_randao_mix(state, get_current_epoch(state))
+    # Verify timestamp
+    assert payload.timestamp == compute_timestamp_at_slot(state, state.slot)
+    # Verify the execution payload is valid
+    assert execution_engine.execute_payload(payload)
+    # Cache execution payload header
+    state.latest_execution_payload_header = ExecutionPayloadHeader(
+        parent_hash=payload.parent_hash,
+        coinbase=payload.coinbase,
+        state_root=payload.state_root,
+        receipt_root=payload.receipt_root,
+        logs_bloom=payload.logs_bloom,
+        random=payload.random,
+        block_number=payload.block_number,
+        gas_limit=payload.gas_limit,
+        gas_used=payload.gas_used,
+        timestamp=payload.timestamp,
+        extra_data=payload.extra_data,
+        base_fee_per_gas=payload.base_fee_per_gas,
+        block_hash=payload.block_hash,
+        transactions_root=hash_tree_root(payload.transactions),
+    )
+
+
+# ---------------------------------------------------------------------------
+# genesis for pure-Merge testing (merge/beacon-chain.md:325-382)
+# ---------------------------------------------------------------------------
+
+def initialize_beacon_state_from_eth1(eth1_block_hash: Bytes32,
+                                      eth1_timestamp: uint64,
+                                      deposits: Sequence[Deposit],
+                                      execution_payload_header: ExecutionPayloadHeader=None
+                                      ) -> BeaconState:
+    # (merge/beacon-chain.md:335-382 — MERGE_FORK_VERSION genesis; an empty
+    # payload header means the Merge has not yet occurred)
+    if execution_payload_header is None:
+        execution_payload_header = ExecutionPayloadHeader()
+    fork = Fork(
+        previous_version=config.MERGE_FORK_VERSION,  # [Modified in Merge] for testing only
+        current_version=config.MERGE_FORK_VERSION,  # [Modified in Merge]
+        epoch=GENESIS_EPOCH,
+    )
+    state = BeaconState(
+        genesis_time=eth1_timestamp + config.GENESIS_DELAY,
+        fork=fork,
+        eth1_data=Eth1Data(block_hash=eth1_block_hash, deposit_count=uint64(len(deposits))),
+        latest_block_header=BeaconBlockHeader(body_root=hash_tree_root(BeaconBlockBody())),
+        randao_mixes=[eth1_block_hash] * EPOCHS_PER_HISTORICAL_VECTOR,  # Seed RANDAO with Eth1 entropy
+    )
+
+    # Process deposits
+    leaves = list(map(lambda deposit: deposit.data, deposits))
+    for index, deposit in enumerate(deposits):
+        deposit_data_list = List[DepositData, 2**DEPOSIT_CONTRACT_TREE_DEPTH](*leaves[:index + 1])
+        state.eth1_data.deposit_root = hash_tree_root(deposit_data_list)
+        process_deposit(state, deposit)
+
+    # Process activations
+    for index, validator in enumerate(state.validators):
+        balance = state.balances[index]
+        validator.effective_balance = min(balance - balance % EFFECTIVE_BALANCE_INCREMENT, MAX_EFFECTIVE_BALANCE)
+        if validator.effective_balance == MAX_EFFECTIVE_BALANCE:
+            validator.activation_eligibility_epoch = GENESIS_EPOCH
+            validator.activation_epoch = GENESIS_EPOCH
+
+    # Set genesis validators root for domain separation and chain versioning
+    state.genesis_validators_root = hash_tree_root(state.validators)
+
+    # Fill in sync committees
+    # Note: A duplicate committee is assigned for the current and next committee at genesis
+    state.current_sync_committee = get_next_sync_committee(state)
+    state.next_sync_committee = get_next_sync_committee(state)
+
+    # [New in Merge] Initialize the execution payload header
+    # If empty, will initialize a chain that has not yet gone through the Merge transition
+    state.latest_execution_payload_header = execution_payload_header
+
+    return state

@@ -1,0 +1,115 @@
+# The Merge — Fork Choice (executable spec source)
+#
+# Provenance: function bodies transcribed from the spec text (reference
+# specs/merge/fork-choice.md) — conformance requires identical semantics.
+# The get_pow_block testing stub mirrors reference setup.py:509-514.
+
+
+@dataclass
+class PayloadAttributes(object):
+    # (merge/fork-choice.md:64-74)
+    timestamp: uint64
+    random: Bytes32
+    fee_recipient: ExecutionAddress
+
+
+class PowBlock(Container):
+    # (merge/fork-choice.md:76-85)
+    block_hash: Hash32
+    parent_hash: Hash32
+    total_difficulty: uint256
+    difficulty: uint256
+
+
+def get_pow_block(block_hash: Hash32) -> Optional[PowBlock]:
+    """Testing stub: a synthetic PoW block keyed by its hash (production
+    implementations fetch via the execution JSON-RPC; reference
+    setup.py:509-514 injects the same stub)."""
+    return PowBlock(block_hash=block_hash, parent_hash=Hash32(), total_difficulty=uint256(0), difficulty=uint256(0))
+
+
+def is_valid_terminal_pow_block(block: PowBlock, parent: PowBlock) -> bool:
+    # (merge/fork-choice.md:93-106 — TTD crossing, or explicit hash override)
+    if config.TERMINAL_BLOCK_HASH != Hash32():
+        return block.block_hash == config.TERMINAL_BLOCK_HASH
+
+    is_total_difficulty_reached = block.total_difficulty >= config.TERMINAL_TOTAL_DIFFICULTY
+    is_parent_total_difficulty_valid = parent.total_difficulty < config.TERMINAL_TOTAL_DIFFICULTY
+    return is_total_difficulty_reached and is_parent_total_difficulty_valid
+
+
+def validate_merge_block(block: BeaconBlock) -> None:
+    """
+    Check the parent PoW block of execution payload is a valid terminal PoW block.
+    (merge/fork-choice.md:107-131)
+    """
+    pow_block = get_pow_block(block.body.execution_payload.parent_hash)
+    # Check if `pow_block` is available
+    assert pow_block is not None
+    pow_parent = get_pow_block(pow_block.parent_hash)
+    # Check if `pow_parent` is available
+    assert pow_parent is not None
+    # Check if `pow_block` is a valid terminal PoW block
+    assert is_valid_terminal_pow_block(pow_block, pow_parent)
+
+    # If `TERMINAL_BLOCK_HASH` is used as an override, the activation epoch must be reached.
+    if config.TERMINAL_BLOCK_HASH != Hash32():
+        assert compute_epoch_at_slot(block.slot) >= config.TERMINAL_BLOCK_HASH_ACTIVATION_EPOCH
+
+
+def on_block(store: Store, signed_block: SignedBeaconBlock) -> None:
+    """
+    Run ``on_block`` upon receiving a new block.
+    (merge/fork-choice.md:134-196 — adds terminal-PoW validation of the
+    merge-transition block to phase0's handler)
+    """
+    block = signed_block.message
+    # Parent block must be known
+    assert block.parent_root in store.block_states
+    # Make a copy of the state to avoid mutability issues
+    pre_state = copy(store.block_states[block.parent_root])
+    # Blocks cannot be in the future. If they are, their consideration must be delayed until they are in the past.
+    assert get_current_slot(store) >= block.slot
+
+    # Check that block is later than the finalized epoch slot (optimization to reduce calls to get_ancestor)
+    finalized_slot = compute_start_slot_at_epoch(store.finalized_checkpoint.epoch)
+    assert block.slot > finalized_slot
+    # Check block is a descendant of the finalized block at the checkpoint finalized slot
+    assert get_ancestor(store, block.parent_root, finalized_slot) == store.finalized_checkpoint.root
+
+    # Check the block is valid and compute the post-state
+    state = pre_state.copy()
+    state_transition(state, signed_block, True)
+
+    # [New in Merge]
+    if is_merge_block(pre_state, block.body):
+        validate_merge_block(block)
+
+    # Add new block to the store
+    store.blocks[hash_tree_root(block)] = block
+    # Add new state for this block to the store
+    store.block_states[hash_tree_root(block)] = state
+
+    # Update justified checkpoint
+    if state.current_justified_checkpoint.epoch > store.justified_checkpoint.epoch:
+        if state.current_justified_checkpoint.epoch > store.best_justified_checkpoint.epoch:
+            store.best_justified_checkpoint = state.current_justified_checkpoint
+        if should_update_justified_checkpoint(store, state.current_justified_checkpoint):
+            store.justified_checkpoint = state.current_justified_checkpoint
+
+    # Update finalized checkpoint
+    if state.finalized_checkpoint.epoch > store.finalized_checkpoint.epoch:
+        store.finalized_checkpoint = state.finalized_checkpoint
+
+        # Potentially update justified if different from store
+        if store.justified_checkpoint != state.current_justified_checkpoint:
+            # Update justified if new justified is later than store justified
+            if state.current_justified_checkpoint.epoch > store.justified_checkpoint.epoch:
+                store.justified_checkpoint = state.current_justified_checkpoint
+                return
+
+            # Update justified if store justified is not in chain with finalized checkpoint
+            finalized_slot = compute_start_slot_at_epoch(store.finalized_checkpoint.epoch)
+            ancestor_at_finalized_slot = get_ancestor(store, store.justified_checkpoint.root, finalized_slot)
+            if ancestor_at_finalized_slot != store.finalized_checkpoint.root:
+                store.justified_checkpoint = state.current_justified_checkpoint

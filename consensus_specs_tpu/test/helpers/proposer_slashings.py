@@ -4,9 +4,8 @@ from .keys import privkeys
 
 
 def get_min_slashing_penalty_quotient(spec):
-    if spec.fork == "merge":
-        return spec.MIN_SLASHING_PENALTY_QUOTIENT_MERGE
-    if spec.fork == "altair":
+    # v1.1.3: merge carries altair's slashing parameters unchanged
+    if spec.fork in ("altair", "merge"):
         return spec.MIN_SLASHING_PENALTY_QUOTIENT_ALTAIR
     return spec.MIN_SLASHING_PENALTY_QUOTIENT
 

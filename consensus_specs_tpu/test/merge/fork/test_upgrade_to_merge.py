@@ -1,0 +1,44 @@
+"""upgrade_to_merge fork-transition tests
+(spec: reference specs/merge/fork.md:30-85)."""
+from ...context import ALTAIR, MERGE, spec_state_test, with_phases
+from ...helpers.state import next_epoch
+
+
+def _upgrade(phases, pre_state):
+    merge = phases[MERGE]
+    post = merge.upgrade_to_merge(pre_state)
+    assert post.fork.previous_version == pre_state.fork.current_version
+    assert post.fork.current_version == merge.config.MERGE_FORK_VERSION
+    assert post.fork.epoch == phases[ALTAIR].get_current_epoch(pre_state)
+    assert post.slot == pre_state.slot
+    assert list(post.balances) == list(pre_state.balances)
+    assert list(post.inactivity_scores) == list(pre_state.inactivity_scores)
+    assert post.current_sync_committee == pre_state.current_sync_committee
+    assert post.next_sync_committee == pre_state.next_sync_committee
+    # the merge starts incomplete: empty payload header
+    assert post.latest_execution_payload_header == merge.ExecutionPayloadHeader()
+    assert not merge.is_merge_complete(post)
+    return post
+
+
+@with_phases([ALTAIR], other_phases=[MERGE])
+@spec_state_test
+def test_upgrade_fresh_state(spec, state, phases):
+    yield 'pre', state
+    post = _upgrade(phases, state)
+    yield 'post', post
+
+
+@with_phases([ALTAIR], other_phases=[MERGE])
+@spec_state_test
+def test_upgrade_after_epochs(spec, state, phases):
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    # dirty some participation so the carried fields are nontrivial
+    state.previous_epoch_participation = [
+        spec.ParticipationFlags(i % 8) for i in range(len(state.validators))
+    ]
+    yield 'pre', state
+    post = _upgrade(phases, state)
+    assert list(post.previous_epoch_participation) == list(state.previous_epoch_participation)
+    yield 'post', post

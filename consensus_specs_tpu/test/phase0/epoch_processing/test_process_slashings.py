@@ -19,9 +19,8 @@ def slash_validators(spec, state, indices, out_epochs):
 
 
 def get_slashing_multiplier(spec):
-    if spec.fork == "merge":
-        return spec.PROPORTIONAL_SLASHING_MULTIPLIER_MERGE
-    if spec.fork == "altair":
+    # v1.1.3: merge carries altair's slashing parameters unchanged
+    if spec.fork in ("altair", "merge"):
         return spec.PROPORTIONAL_SLASHING_MULTIPLIER_ALTAIR
     return spec.PROPORTIONAL_SLASHING_MULTIPLIER
 

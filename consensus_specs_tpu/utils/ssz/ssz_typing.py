@@ -670,8 +670,12 @@ class ComplexSeries(View):
 
     def __eq__(self, other):
         if isinstance(other, ComplexSeries):
+            # element types are compared by NAME: each built fork module
+            # declares its own classes, and same-shape values must compare
+            # equal across modules (see Container.__eq__)
             return (
-                self.ELEM_TYPE is other.ELEM_TYPE
+                (self.ELEM_TYPE is other.ELEM_TYPE
+                 or self.ELEM_TYPE.__name__ == other.ELEM_TYPE.__name__)
                 and type(self).__name__.split("[")[0] == type(other).__name__.split("[")[0]
                 and self._elems == other._elems
             )

@@ -1,0 +1,1 @@
+from consensus_specs_tpu.test.merge.block_processing.test_process_execution_payload import *  # noqa: F401,F403

@@ -1,0 +1,1 @@
+from consensus_specs_tpu.test.merge.fork.test_upgrade_to_merge import *  # noqa: F401,F403
