@@ -3,7 +3,7 @@
 A ground-up rebuild of the capabilities of the eth2 `consensus-specs` pyspec
 (reference: /root/reference, v1.1.3): executable phase0/altair/merge specs with
 mainnet+minimal presets, an SSZ engine, a multi-backend BLS switchboard whose
-fast path is JAX/Pallas BLS12-381 kernels on TPU, a test harness, and
+fast path is XLA-compiled BLS12-381 batch verification for TPU (ops/), a test harness, and
 cross-client test-vector generators.
 
 Layout (mirrors SURVEY.md layer map):

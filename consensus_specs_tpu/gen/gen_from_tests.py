@@ -1,0 +1,84 @@
+"""Reflection bridge: pytest-style spec tests -> generator cases
+(reference: gen_helpers/gen_from_tests/gen.py:13-132).
+
+The same decorated test functions that pytest drains double as vector
+emitters: calling one with ``generator_mode=True`` makes the decorator stack
+return the typed parts instead (test/context.py vector_test).
+"""
+import inspect
+from importlib import import_module
+from typing import Dict, Iterable
+
+from ..test import context
+from .gen_typing import TestCase, TestProvider
+
+
+def generate_from_tests(runner_name: str, handler_name: str, src,
+                        fork_name: str, preset_name: str,
+                        bls_active: bool = True) -> Iterable[TestCase]:
+    """One TestCase per ``test_*`` function of a module, named without the
+    ``test_`` prefix (reference gen.py:30-56)."""
+    for name, fn in inspect.getmembers(src, inspect.isfunction):
+        if not name.startswith("test_"):
+            continue
+        case_name = name[len("test_"):]
+
+        def case_fn(fn=fn):
+            return fn(
+                generator_mode=True,
+                preset=preset_name,
+                phase=fork_name,
+                bls_active=bls_active,
+            )
+
+        yield TestCase(
+            fork_name=fork_name,
+            preset_name=preset_name,
+            runner_name=runner_name,
+            handler_name=handler_name,
+            suite_name=getattr(fn, "suite_name", "pyspec_tests"),
+            case_name=case_name,
+            case_fn=case_fn,
+        )
+
+
+def _module_cases(runner_name: str, mod_path: str, fork: str, preset: str):
+    src = import_module(mod_path)
+    handler = mod_path.split(".")[-1].replace("test_", "")
+    yield from generate_from_tests(runner_name, handler, src, fork, preset)
+
+
+def run_state_test_generators(runner_name: str,
+                              all_mods: Dict[str, Dict[str, str]],
+                              args=None) -> int:
+    """``all_mods``: {fork: {handler: module path}}; runs the generator CLI
+    over presets x forks x modules (reference gen.py:96-111)."""
+    from .gen_runner import run_generator
+
+    def make_cases():
+        for preset in ("minimal", "mainnet"):
+            for fork, mods in all_mods.items():
+                for handler, mod_path in mods.items():
+                    src = import_module(mod_path)
+                    yield from generate_from_tests(
+                        runner_name, handler, src, fork, preset
+                    )
+
+    def prepare():
+        # pin the pure-python oracle backend (the reference prepares milagro,
+        # gen.py:74-77; this framework's fast backend is the device one,
+        # selected explicitly per run instead)
+        from ..utils import bls
+
+        bls.use_py_ecc()
+
+    provider = TestProvider(prepare=prepare, make_cases=make_cases)
+    return run_generator(runner_name, [provider], args=args)
+
+
+def combine_mods(dict_1: Dict[str, str], dict_2: Dict[str, str]) -> Dict[str, str]:
+    """Merge handler->module maps; later entries win
+    (reference gen.py:114-132)."""
+    out = dict(dict_1)
+    out.update(dict_2)
+    return out

@@ -1,0 +1,37 @@
+"""`operations` test-vector generator: every process_* op handler
+(reference: tests/generators/operations/main.py; format
+tests/formats/operations/README.md)."""
+import sys
+
+from ..gen_from_tests import combine_mods, run_state_test_generators
+
+_T = "consensus_specs_tpu.test"
+
+PHASE0_MODS = {
+    "attestation": f"{_T}.phase0.block_processing.test_process_attestation",
+    "attester_slashing": f"{_T}.phase0.block_processing.test_process_attester_slashing",
+    "block_header": f"{_T}.phase0.block_processing.test_process_block_header",
+    "deposit": f"{_T}.phase0.block_processing.test_process_deposit",
+    "proposer_slashing": f"{_T}.phase0.block_processing.test_process_proposer_slashing",
+    "voluntary_exit": f"{_T}.phase0.block_processing.test_process_voluntary_exit",
+}
+ALTAIR_MODS = combine_mods(PHASE0_MODS, {
+    "sync_aggregate": f"{_T}.altair.block_processing.test_process_sync_aggregate",
+})
+MERGE_MODS = combine_mods(ALTAIR_MODS, {
+    "execution_payload": f"{_T}.merge.block_processing.test_process_execution_payload",
+})
+
+ALL_MODS = {
+    "phase0": PHASE0_MODS,
+    "altair": ALTAIR_MODS,
+    "merge": MERGE_MODS,
+}
+
+
+def main(args=None) -> int:
+    return run_state_test_generators("operations", ALL_MODS, args=args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,23 @@
+"""`sanity` test-vector generator: whole-state-transition blocks + slots
+(reference: tests/generators/sanity/main.py; format
+tests/formats/sanity/README.md)."""
+import sys
+
+from ..gen_from_tests import run_state_test_generators
+
+_T = "consensus_specs_tpu.test"
+
+MODS = {
+    "blocks": f"{_T}.phase0.sanity.test_blocks",
+    "slots": f"{_T}.phase0.sanity.test_slots",
+}
+
+ALL_MODS = {fork: MODS for fork in ("phase0", "altair", "merge")}
+
+
+def main(args=None) -> int:
+    return run_state_test_generators("sanity", ALL_MODS, args=args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -4,7 +4,8 @@
 # its *computable* parts are these constants, SSZ message containers, and pure
 # functions. The gossip/reqresp transport itself is specified, not executed
 # (SURVEY.md section 2.7/P5) — in this TPU build, inter-node fan-out of the
-# verification workload rides XLA collectives (consensus_specs_tpu.parallel).
+# verification workload rides the jax.sharding mesh path (ops/vm.py
+# _vm_run_for_mesh; driven end-to-end by __graft_entry__.dryrun_multichip).
 
 # Network configuration (p2p-interface.md:168-184)
 GOSSIP_MAX_SIZE = 2**20  # 1 MiB

@@ -176,6 +176,17 @@ def vector_test(description=None):
                 for part in out:
                     if len(part) == 2:
                         (name, value) = part
+                        if value is None:
+                            # e.g. `post: None` for invalid cases — the
+                            # part's absence IS the signal (formats docs)
+                            continue
+                        if isinstance(value, list):
+                            # indexed parts + count meta (reference
+                            # test/utils/utils.py:40-55)
+                            for i, item in enumerate(value):
+                                parts.append(_infer_part(f"{name}_{i}", item))
+                            parts.append((f"{name}_count", "meta", len(value)))
+                            continue
                         parts.append(_infer_part(name, value))
                     else:
                         parts.append(part)
@@ -194,10 +205,14 @@ def _infer_part(name, value):
     from ..utils.ssz.ssz_typing import View
 
     if isinstance(value, View):
-        return (name, "ssz", value)
+        # serialize NOW: the test generator keeps mutating the live object
+        # after yielding it (e.g. `yield 'pre', state` then process_*)
+        return (name, "ssz", value.encode_bytes())
     if isinstance(value, bytes):
         return (name, "bytes", value)
-    return (name, "data", value)
+    import copy as _copy
+
+    return (name, "data", _copy.deepcopy(value))
 
 
 def bls_switch(fn):
@@ -321,6 +336,12 @@ def with_phases(phases, other_phases=None):
         @_wraps(fn)
         def wrapper(*args, **kw):
             run_phases = _phases_to_run(phases)
+            # generator mode runs one (fork, preset) at a time via `phase`
+            only_phase = kw.pop("phase", None)
+            if only_phase is not None:
+                run_phases = [p for p in run_phases if p == only_phase]
+                if len(run_phases) == 0:
+                    return None  # this test doesn't cover the requested fork
             if len(run_phases) == 0:
                 import pytest
 

@@ -1,4 +1,7 @@
-"""Attestation-building helpers (reference: test/helpers/attestations.py)."""
+"""Attestation-building helpers (reference: test/helpers/attestations.py).
+
+Provenance: adapted from the reference's test/helpers/attestations.py — scenario code and comments largely follow the reference test suite (round-1 port); newer suites in this repo are original.
+"""
 from .block import build_empty_block_for_next_slot
 from .forks import is_post_altair
 from .keys import privkeys

@@ -1,4 +1,7 @@
-"""Block-building helpers (reference: test/helpers/block.py)."""
+"""Block-building helpers (reference: test/helpers/block.py).
+
+Provenance: adapted from the reference's test/helpers/block.py — scenario code and comments largely follow the reference test suite (round-1 port); newer suites in this repo are original.
+"""
 from .forks import is_post_altair
 from .keys import privkeys
 

@@ -1,5 +1,8 @@
 """Deposit-building helpers with real Merkle proofs
-(reference: test/helpers/deposits.py)."""
+(reference: test/helpers/deposits.py).
+
+Provenance: adapted from the reference's test/helpers/deposits.py — scenario code and comments largely follow the reference test suite (round-1 port); newer suites in this repo are original.
+"""
 from ...utils.merkle_minimal import calc_merkle_tree_from_leaves, get_merkle_proof
 from .keys import privkeys, pubkeys
 

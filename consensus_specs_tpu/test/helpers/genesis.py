@@ -1,6 +1,9 @@
 """Genesis-state builder: validators are installed directly (no deposit replay).
 
 (reference: tests/core/pyspec/eth2spec/test/helpers/genesis.py:42-103)
+
+
+Provenance: adapted from the reference's test/helpers/genesis.py — scenario code and comments largely follow the reference test suite (round-1 port); newer suites in this repo are original.
 """
 from .forks import is_post_altair, is_post_merge
 from .keys import pubkeys

@@ -1,4 +1,7 @@
-"""Proposer-slashing helpers (reference: test/helpers/proposer_slashings.py)."""
+"""Proposer-slashing helpers (reference: test/helpers/proposer_slashings.py).
+
+Provenance: adapted from the reference's test/helpers/proposer_slashings.py — scenario code and comments largely follow the reference test suite (round-1 port); newer suites in this repo are original.
+"""
 from .block import sign_block_header
 from .keys import privkeys
 

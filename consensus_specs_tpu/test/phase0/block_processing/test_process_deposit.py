@@ -1,5 +1,8 @@
 """process_deposit handler tests
-(reference: test/phase0/block_processing/test_process_deposit.py)."""
+(reference: test/phase0/block_processing/test_process_deposit.py).
+
+Provenance: adapted from the reference's test/phase0/block_processing/test_process_deposit.py — scenario code and comments largely follow the reference test suite (round-1 port); newer suites in this repo are original.
+"""
 from ...context import (
     always_bls, spec_state_test, with_all_phases,
 )

@@ -1,5 +1,8 @@
 """process_registry_updates tests
-(reference: test/phase0/epoch_processing/test_process_registry_updates.py)."""
+(reference: test/phase0/epoch_processing/test_process_registry_updates.py).
+
+Provenance: adapted from the reference's test/phase0/epoch_processing/test_process_registry_updates.py — scenario code and comments largely follow the reference test suite (round-1 port); newer suites in this repo are original.
+"""
 from ...context import (
     scaled_churn_balances, spec_state_test, spec_test, with_all_phases,
     with_custom_state, zero_activation_threshold, default_activation_threshold,

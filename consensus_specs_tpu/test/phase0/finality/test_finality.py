@@ -1,4 +1,7 @@
-"""Multi-epoch finality tests (reference: test/phase0/finality/test_finality.py)."""
+"""Multi-epoch finality tests (reference: test/phase0/finality/test_finality.py).
+
+Provenance: adapted from the reference's test/phase0/finality/test_finality.py — scenario code and comments largely follow the reference test suite (round-1 port); newer suites in this repo are original.
+"""
 from ...context import PHASE0, spec_state_test, with_all_phases, with_phases
 from ...helpers.attestations import next_epoch_with_attestations
 from ...helpers.state import next_epoch, next_epoch_via_block

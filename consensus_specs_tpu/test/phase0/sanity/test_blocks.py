@@ -1,4 +1,7 @@
-"""Sanity block-transition tests (reference: test/phase0/sanity/test_blocks.py)."""
+"""Sanity block-transition tests (reference: test/phase0/sanity/test_blocks.py).
+
+Provenance: adapted from the reference's test/phase0/sanity/test_blocks.py — scenario code and comments largely follow the reference test suite (round-1 port); newer suites in this repo are original.
+"""
 from ...context import (
     always_bls, expect_assertion_error, spec_state_test, with_all_phases,
 )

@@ -8,8 +8,9 @@ Mirrors the reference's backend-switch design
              reference's py_ecc==5.2.0 is replaced by our implementation).
 - "milagro": alias of the oracle (the reference's milagro C binding has no
              place here; kept so `use_milagro()` call sites keep working).
-- "tpu":     the JAX/Pallas batched backend in `consensus_specs_tpu.ops`
-             (the reference's native-C-equivalent, lowered to TPU kernels).
+- "tpu":     the JAX/XLA batched backend in `consensus_specs_tpu.ops`
+             (the reference's native-C-equivalent, lowered through XLA; see
+             ops/vm.py for the execution model).
 
 Ciphersuite: BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_ (IETF BLS draft v4,
 reference specs/phase0/beacon-chain.md:631-652).
@@ -40,7 +41,15 @@ def use_py_ecc():
 
 
 def use_milagro():
-    # API-parity alias: this build has no milagro C binding; the oracle serves.
+    # API-parity alias: this build has no milagro C binding; the oracle
+    # serves — warn so callers don't silently believe they got the fast path
+    import warnings
+
+    warnings.warn(
+        "use_milagro(): no milagro binding in this build; using the "
+        "pure-python oracle (use_tpu() selects the fast backend)",
+        stacklevel=2,
+    )
     global _backend
     _backend = "py_ecc"
 

@@ -2,7 +2,7 @@
 
 Ground-up pure-Python implementation replacing the reference's external
 `py_ecc==5.2.0` dependency (reference: tests/core/pyspec/eth2spec/utils/bls.py:1-2).
-This module is the CPU correctness oracle for the JAX/Pallas TPU backend in
+This module is the CPU correctness oracle for the JAX/XLA TPU backend in
 `consensus_specs_tpu.ops` — the TPU kernels are cross-checked bit-identically
 against it (the same pattern the reference uses between py_ecc and milagro,
 tests/generators/bls/main.py:80,108-114).

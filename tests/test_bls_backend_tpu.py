@@ -96,3 +96,84 @@ def test_switchboard_tpu_backend_routing():
     assert bls.Verify(PUBKEYS[6], msg, sig) is False
     agg = bls.Aggregate([bls.Sign(sk, msg) for sk in PRIVKEYS[:2]])
     assert bls.FastAggregateVerify(PUBKEYS[:2], msg, agg) is True
+
+
+def test_bucket_boundary_64_65():
+    """K=64 fills the 64-bucket exactly; K=65 rolls into the 128 bucket —
+    both must agree with the oracle (ops/bls_backend.py _K_BUCKETS)."""
+    from consensus_specs_tpu.ops import bls_backend
+    from consensus_specs_tpu.utils.bls12_381 import R
+
+    assert bls_backend._k_bucket(64) == 64
+    assert bls_backend._k_bucket(65) == 128
+
+    for k in (64, 65):
+        sks = list(range(1, k + 1))
+        pks = [bls.SkToPk(sk) for sk in sks]
+        msg = bytes([k]) * 32
+        sig = bls.Sign(sum(sks) % R, msg)  # aggregate via summed secret key
+        assert bool(
+            bls_backend.batch_fast_aggregate_verify([pks], [msg], [sig])[0]
+        ) is True
+        # drop one signer: must fail in the same bucket shape
+        assert bool(
+            bls_backend.batch_fast_aggregate_verify([pks[:-1]], [msg], [sig])[0]
+        ) is False
+
+
+def test_random_invalid_encodings_match_oracle():
+    """Random/malformed pubkey+signature byte strings: backend and oracle
+    must agree on every rejection (the reference's py_ecc-vs-milagro pattern,
+    reference generators/bls/main.py:80, 108-114)."""
+    import random
+
+    from consensus_specs_tpu.ops import bls_backend
+
+    rng = random.Random(99)
+    msg = b"\x77" * 32
+    good_sig = bls.Sign(PRIVKEYS[0], msg)
+
+    bad_pubkeys = [
+        bytes(rng.randrange(256) for _ in range(48)),  # random bytes
+        b"\x00" * 48,                                   # no compression flag
+        b"\xc0" + b"\x00" * 47,                         # infinity
+        bytes([0x80]) + b"\xff" * 47,                   # x >= p territory
+        PUBKEYS[0][:-1] + bytes([PUBKEYS[0][-1] ^ 1]),  # bit flip (off-curve)
+    ]
+    for pk in bad_pubkeys:
+        got = bls_backend.verify(pk, msg, good_sig)
+        want = bls.Verify(pk, msg, good_sig)
+        assert got == want == False  # noqa: E712
+
+    bad_sigs = [
+        bytes(rng.randrange(256) for _ in range(96)),
+        b"\x00" * 96,
+        b"\xc0" + b"\x00" * 95,  # infinity signature
+        good_sig[:-1] + bytes([good_sig[-1] ^ 1]),
+    ]
+    for sig in bad_sigs:
+        got = bls_backend.verify(PUBKEYS[0], msg, sig)
+        want = bls.Verify(PUBKEYS[0], msg, sig)
+        assert got == want == False  # noqa: E712
+
+
+@pytest.mark.skipif(
+    "CONSENSUS_SPECS_TPU_WIDE_K" not in __import__("os").environ,
+    reason="wide-committee compiles take minutes on CPU; set "
+    "CONSENSUS_SPECS_TPU_WIDE_K=1 (TPU runs should)",
+)
+@pytest.mark.parametrize("k", [512, 2048])
+def test_wide_committee_matches_oracle(k):
+    """Sync-committee width (512) and mainnet max committee (2048)
+    (BASELINE.md workload constants)."""
+    from consensus_specs_tpu.ops import bls_backend
+    from consensus_specs_tpu.utils.bls12_381 import R
+
+    sks = list(range(1, k + 1))
+    pks = [bls.SkToPk(sk) for sk in sks]
+    msg = bytes([k % 251]) * 32
+    sig = bls.Sign(sum(sks) % R, msg)
+    got = bls_backend.batch_fast_aggregate_verify([pks], [msg], [sig])
+    assert bool(got[0]) is True
+    got_bad = bls_backend.batch_fast_aggregate_verify([pks[1:]], [msg], [sig])
+    assert bool(got_bad[0]) is False

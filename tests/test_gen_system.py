@@ -1,0 +1,97 @@
+"""Generator system (L6) tests: snappy codec, runner lifecycle, vector
+round-trip (consensus_specs_tpu/gen/; reference gen_runner.py:41-235)."""
+import random
+
+import pytest
+
+from consensus_specs_tpu.utils.snappy import compress, decompress
+
+
+def test_snappy_roundtrip():
+    rng = random.Random(7)
+    for n in (0, 1, 59, 60, 61, 255, 4096, 70000):
+        data = bytes(rng.randrange(256) for _ in range(n))
+        assert decompress(compress(data)) == data
+
+
+def test_snappy_decodes_copies():
+    # hand-built stream with a 1-byte-offset copy: "abcabcabcabc"
+    # literal "abc" (tag 0b000010_00 -> len 3), then copy len 9 offset 3
+    stream = bytes([12]) + bytes([(3 - 1) << 2]) + b"abc" + bytes([((9 - 4) << 2) | 1, 3])
+    assert decompress(stream) == b"abcabcabcabc"
+
+
+def test_gen_runner_lifecycle(tmp_path):
+    from consensus_specs_tpu.gen.gen_runner import detect_incomplete, run_generator
+    from consensus_specs_tpu.gen.gen_typing import TestCase, TestProvider
+
+    calls = []
+
+    def make_case(name, fn):
+        return TestCase(
+            fork_name="phase0", preset_name="minimal", runner_name="demo",
+            handler_name="h", suite_name="s", case_name=name, case_fn=fn,
+        )
+
+    def good():
+        calls.append("good")
+        return [("value", "data", {"x": 1}), ("blob", "ssz", b"\x01\x02"),
+                ("note", "meta", "hi")]
+
+    def bad():
+        raise RuntimeError("boom")
+
+    provider = TestProvider(
+        prepare=lambda: None,
+        make_cases=lambda: [make_case("ok", good), make_case("crash", bad)],
+    )
+    rc = run_generator("demo", [provider], args=["-o", str(tmp_path)])
+    assert rc == 1  # failure reported
+    ok_dir = tmp_path / "minimal/phase0/demo/h/s/ok"
+    assert (ok_dir / "value.yaml").exists()
+    assert decompress((ok_dir / "blob.ssz_snappy").read_bytes()) == b"\x01\x02"
+    assert "note" in (ok_dir / "meta.yaml").read_text()
+    assert not (ok_dir / "INCOMPLETE").exists()
+    # the crashed case keeps its sentinel for regeneration
+    crash_dir = tmp_path / "minimal/phase0/demo/h/s/crash"
+    assert (crash_dir / "INCOMPLETE").exists()
+    assert detect_incomplete(tmp_path) == [str(crash_dir)]
+    assert (tmp_path / "testgen_error_log.txt").read_text().count("boom") == 1
+
+    # incremental: second run skips the complete case, retries the crashed one
+    calls.clear()
+    run_generator("demo", [provider], args=["-o", str(tmp_path)])
+    assert calls == []  # good case not re-run
+
+
+@pytest.mark.slow
+def test_operations_vector_roundtrip(tmp_path):
+    """Generate one handler's vectors and REPLAY one like a client would."""
+    from consensus_specs_tpu.gen.gen_from_tests import run_state_test_generators
+
+    mods = {"phase0": {
+        "attestation":
+            "consensus_specs_tpu.test.phase0.block_processing.test_process_attestation",
+    }}
+    rc = run_state_test_generators(
+        "operations", mods, args=["-o", str(tmp_path), "-l", "minimal"]
+    )
+    assert rc == 0
+    case = tmp_path / "minimal/phase0/operations/attestation/pyspec_tests/success"
+    from consensus_specs_tpu.builder import build_spec_module
+
+    spec = build_spec_module("phase0", "minimal")
+    state = spec.BeaconState.decode_bytes(
+        decompress((case / "pre.ssz_snappy").read_bytes())
+    )
+    att = spec.Attestation.decode_bytes(
+        decompress((case / "attestation.ssz_snappy").read_bytes())
+    )
+    post = spec.BeaconState.decode_bytes(
+        decompress((case / "post.ssz_snappy").read_bytes())
+    )
+    spec.process_attestation(state, att)
+    assert state.hash_tree_root() == post.hash_tree_root()
+    # invalid case: no post part on disk
+    invalid = tmp_path / "minimal/phase0/operations/attestation/pyspec_tests/future_target_epoch"
+    assert invalid.exists() and not (invalid / "post.ssz_snappy").exists()
