@@ -1,0 +1,165 @@
+"""`bls` test-vector generator: the 7 IETF-BLS handler suites, with every
+case CROSS-CHECKED between the pure-python oracle and the TPU backend — the
+reference's py_ecc-vs-milagro dual-implementation pattern
+(reference: tests/generators/bls/main.py, cross-checks at :80, 108-114)."""
+import sys
+
+from ...utils import bls
+from ..gen_runner import run_generator
+from ..gen_typing import TestCase, TestProvider
+
+PRIVKEYS = [
+    0x263DBD792F5B1BE47ED85F8938C0F29586AF0B3AC7B257FE09659B64F9C1BC47,
+    0x47B8192D77BF871B62E87859D653922725724A5C031AFEABC60BCEF5FF665138,
+    0x328388AFF0D4A5B7DC9205ABD374E7E98F3CD9F3418EDB4EAFDA5FB16473D216,
+]
+MESSAGES = [b"\x00" * 32, b"\x56" * 32, b"\xab" * 32]
+
+Z1_PUBKEY = b"\xc0" + b"\x00" * 47
+Z2_SIGNATURE = b"\xc0" + b"\x00" * 95
+
+
+def _hex(b):
+    return "0x" + bytes(b).hex()
+
+
+def _tpu_check(kind, args, expected):
+    """Every verify-family case runs on BOTH implementations."""
+    from ...ops import bls_backend
+
+    if kind == "verify":
+        got = bls_backend.verify(*args)
+    elif kind == "fast_aggregate_verify":
+        got = bls_backend.fast_aggregate_verify(*args)
+    elif kind == "aggregate_verify":
+        got = bls_backend.aggregate_verify(*args)
+    else:
+        return
+    assert got == expected, f"tpu backend disagrees on {kind}: {got} != {expected}"
+
+
+def _cases():
+    # sign
+    for i, sk in enumerate(PRIVKEYS):
+        for j, msg in enumerate(MESSAGES):
+            sig = bls.Sign(sk, msg)
+            yield "sign", f"sign_case_{i}_{j}", {
+                "input": {"privkey": hex(sk), "message": _hex(msg)},
+                "output": _hex(sig),
+            }
+
+    # verify (incl. wrong key / wrong message / malformed)
+    sk, msg = PRIVKEYS[0], MESSAGES[0]
+    pk = bls.SkToPk(sk)
+    sig = bls.Sign(sk, msg)
+    wrong_pk = bls.SkToPk(PRIVKEYS[1])
+    verify_cases = [
+        ("valid", pk, msg, sig, True),
+        ("wrong_pubkey", wrong_pk, msg, sig, False),
+        ("wrong_message", pk, MESSAGES[1], sig, False),
+        ("infinity_pubkey", Z1_PUBKEY, msg, sig, False),
+        ("infinity_signature", pk, msg, Z2_SIGNATURE, False),
+        ("garbage_signature", pk, msg, b"\xff" * 96, False),
+    ]
+    for name, p, m, s, want in verify_cases:
+        got = bls.Verify(p, m, s)
+        assert got == want, name
+        _tpu_check("verify", (p, m, s), want)
+        yield "verify", f"verify_{name}", {
+            "input": {"pubkey": _hex(p), "message": _hex(m), "signature": _hex(s)},
+            "output": want,
+        }
+
+    # aggregate
+    sigs = [bls.Sign(sk, MESSAGES[1]) for sk in PRIVKEYS]
+    agg = bls.Aggregate(sigs)
+    yield "aggregate", "aggregate_3_signatures", {
+        "input": [_hex(s) for s in sigs],
+        "output": _hex(agg),
+    }
+
+    # fast_aggregate_verify
+    pks = [bls.SkToPk(sk) for sk in PRIVKEYS]
+    fav_cases = [
+        ("valid", pks, MESSAGES[1], agg, True),
+        ("missing_signer", pks[:2], MESSAGES[1], agg, False),
+        ("wrong_message", pks, MESSAGES[2], agg, False),
+        ("empty_pubkeys", [], MESSAGES[1], agg, False),
+        ("empty_pubkeys_infinity_sig", [], MESSAGES[1], Z2_SIGNATURE, False),
+        ("infinity_pubkey_member", pks + [Z1_PUBKEY], MESSAGES[1], agg, False),
+    ]
+    for name, p, m, s, want in fav_cases:
+        got = bls.FastAggregateVerify(p, m, s)
+        assert got == want, name
+        _tpu_check("fast_aggregate_verify", (p, m, s), want)
+        yield "fast_aggregate_verify", f"fast_aggregate_verify_{name}", {
+            "input": {"pubkeys": [_hex(x) for x in p], "message": _hex(m),
+                      "signature": _hex(s)},
+            "output": want,
+        }
+
+    # aggregate_verify
+    per_msg_sigs = [bls.Sign(sk, m) for sk, m in zip(PRIVKEYS, MESSAGES)]
+    agg_multi = bls.Aggregate(per_msg_sigs)
+    av_cases = [
+        ("valid", pks, MESSAGES, agg_multi, True),
+        ("swapped_messages", pks, [MESSAGES[1], MESSAGES[0], MESSAGES[2]], agg_multi, False),
+        ("length_mismatch", pks, MESSAGES[:2], agg_multi, False),
+    ]
+    for name, p, m, s, want in av_cases:
+        got = bls.AggregateVerify(p, m, s)
+        assert got == want, name
+        _tpu_check("aggregate_verify", (p, m, s), want)
+        yield "aggregate_verify", f"aggregate_verify_{name}", {
+            "input": {"pubkeys": [_hex(x) for x in p],
+                      "messages": [_hex(x) for x in m],
+                      "signature": _hex(s)},
+            "output": want,
+        }
+
+    # eth_aggregate_pubkeys (altair extension, reference specs/altair/bls.md:33-57)
+    agg_pk = bls.AggregatePKs(pks)
+    yield "eth_aggregate_pubkeys", "aggregate_pubkeys_3", {
+        "input": [_hex(x) for x in pks],
+        "output": _hex(agg_pk),
+    }
+
+    # eth_fast_aggregate_verify (accepts infinity sig for empty participation)
+    from ...builder import build_spec_module
+
+    spec = build_spec_module("altair", "minimal")
+    efav_cases = [
+        ("valid", pks, MESSAGES[1], agg, True),
+        ("empty_infinity_sig", [], MESSAGES[1], Z2_SIGNATURE, True),
+        ("empty_nonzero_sig", [], MESSAGES[1], agg, False),
+    ]
+    for name, p, m, s, want in efav_cases:
+        got = spec.eth_fast_aggregate_verify(p, m, s)
+        assert bool(got) == want, name
+        yield "eth_fast_aggregate_verify", f"eth_fast_aggregate_verify_{name}", {
+            "input": {"pubkeys": [_hex(x) for x in p], "message": _hex(m),
+                      "signature": _hex(s)},
+            "output": want,
+        }
+
+
+def make_cases():
+    for handler, case_name, data in _cases():
+        yield TestCase(
+            fork_name="general",
+            preset_name="general",
+            runner_name="bls",
+            handler_name=handler,
+            suite_name="bls",
+            case_name=case_name,
+            case_fn=lambda data=data: [("data", "data", data)],
+        )
+
+
+def main(args=None) -> int:
+    provider = TestProvider(prepare=lambda: None, make_cases=make_cases)
+    return run_generator("bls", [provider], args=args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

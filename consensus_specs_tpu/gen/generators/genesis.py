@@ -1,0 +1,18 @@
+"""`genesis` test-vector generator (reference: tests/generators/genesis)."""
+import sys
+
+from ..gen_from_tests import run_state_test_generators
+
+_T = "consensus_specs_tpu.test"
+
+ALL_MODS = {
+    "phase0": {"initialization": f"{_T}.phase0.genesis.test_genesis"},
+}
+
+
+def main(args=None) -> int:
+    return run_state_test_generators("genesis", ALL_MODS, args=args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
