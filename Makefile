@@ -1,0 +1,50 @@
+# Build/test orchestration (L8; fills the role of the reference Makefile:90-200).
+# No pip installs happen here — everything runs against the baked-in env.
+
+VECTORS_DIR ?= ../consensus-spec-tests/tests
+PYTEST = JAX_PLATFORMS=cpu python -m pytest
+
+GENERATORS = operations sanity epoch_processing rewards finality forks \
+             fork_choice ssz_static shuffling bls genesis
+
+.PHONY: test citest test_tpu_backend lint generate_tests \
+        detect_generator_incomplete bench multichip clean_vectors
+
+# fast default: BLS stubbed except @always_bls (reference `make test`)
+test:
+	$(PYTEST) tests/ -q
+
+# CI-grade: everything incl. slow VM/pairing compiles, real BLS via the
+# pure-python oracle (reference `make citest` runs milagro)
+citest:
+	$(PYTEST) tests/ -q --run-slow --enable-bls
+
+# the flagship correctness gate: spec tests routed through the TPU backend
+test_tpu_backend:
+	$(PYTEST) tests/phase0 -q --run-slow --bls-type=tpu
+
+# syntax/bytecode sweep (flake8/mypy are not in this image; compileall
+# catches syntax errors and the test run is the real gate)
+lint:
+	python -m compileall -q consensus_specs_tpu tests bench.py __graft_entry__.py
+
+# emit every cross-client vector suite (reference `make generate_tests`)
+generate_tests:
+	@for g in $(GENERATORS); do \
+		JAX_PLATFORMS=cpu python -m consensus_specs_tpu.gen.generators.$$g \
+			-o $(VECTORS_DIR) || exit 1; \
+	done
+
+detect_generator_incomplete:
+	python -c "from consensus_specs_tpu.gen.gen_runner import detect_incomplete; \
+	import sys; bad = detect_incomplete('$(VECTORS_DIR)'); \
+	print('\n'.join(bad) or 'no incomplete cases'); sys.exit(1 if bad else 0)"
+
+bench:
+	python bench.py
+
+multichip:
+	python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('multichip OK')"
+
+clean_vectors:
+	rm -rf $(VECTORS_DIR)

@@ -1,0 +1,168 @@
+"""Honest-validator duty unit tests
+(spec: reference specs/phase0/validator.md; scenario coverage modeled on
+the reference's phase0/unittests/validator/test_validator_unittest.py,
+written for this harness)."""
+from ...context import always_bls, spec_state_test, with_all_phases
+from ...helpers.attestations import get_valid_attestation
+from ...helpers.block import build_empty_block
+from ...helpers.keys import privkeys, pubkeys
+from ...helpers.state import next_epoch
+
+
+@with_all_phases
+@spec_state_test
+def test_check_if_validator_active(spec, state):
+    active = spec.check_if_validator_active(state, 0)
+    assert active  # genesis validators are active
+    # deactivate one
+    state.validators[1].exit_epoch = spec.get_current_epoch(state)
+    assert not spec.check_if_validator_active(state, 1)
+
+
+@with_all_phases
+@spec_state_test
+def test_get_committee_assignment_current_epoch(spec, state):
+    epoch = spec.get_current_epoch(state)
+    seen = set()
+    for index in spec.get_active_validator_indices(state, epoch):
+        assignment = spec.get_committee_assignment(state, epoch, index)
+        assert assignment is not None
+        committee, committee_index, slot = assignment
+        assert index in committee
+        assert spec.compute_epoch_at_slot(slot) == epoch
+        assert committee_index < spec.get_committee_count_per_slot(state, epoch)
+        seen.add(int(index))
+    # every active validator is assigned exactly once per epoch
+    assert seen == set(int(i) for i in spec.get_active_validator_indices(state, epoch))
+
+
+@with_all_phases
+@spec_state_test
+def test_get_committee_assignment_next_epoch_only(spec, state):
+    # querying beyond next epoch must fail
+    from ...context import expect_assertion_error
+
+    next_epoch_num = spec.get_current_epoch(state) + 2
+    expect_assertion_error(
+        lambda: spec.get_committee_assignment(state, next_epoch_num, 0)
+    )
+
+
+@with_all_phases
+@spec_state_test
+def test_is_proposer(spec, state):
+    proposer = spec.get_beacon_proposer_index(state)
+    assert spec.is_proposer(state, proposer)
+    others = [i for i in range(len(state.validators)) if i != proposer]
+    assert not spec.is_proposer(state, others[0])
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_get_epoch_signature_matches_randao_domain(spec, state):
+    block = build_empty_block(spec, state)
+    proposer_index = spec.get_beacon_proposer_index(state)
+    privkey = privkeys[proposer_index]
+    signature = spec.get_epoch_signature(state, block, privkey)
+    domain = spec.get_domain(
+        state, spec.DOMAIN_RANDAO, spec.compute_epoch_at_slot(block.slot)
+    )
+    signing_root = spec.compute_signing_root(
+        spec.compute_epoch_at_slot(block.slot), domain
+    )
+    assert spec.bls.Verify(pubkeys[proposer_index], signing_root, signature)
+
+
+@with_all_phases
+@spec_state_test
+def test_compute_subnet_for_attestation_stable(spec, state):
+    committees_per_slot = spec.get_committee_count_per_slot(
+        state, spec.get_current_epoch(state)
+    )
+    seen = set()
+    for slot in range(int(spec.SLOTS_PER_EPOCH)):
+        for index in range(int(committees_per_slot)):
+            subnet = spec.compute_subnet_for_attestation(
+                committees_per_slot, spec.Slot(slot), spec.CommitteeIndex(index)
+            )
+            assert 0 <= int(subnet) < spec.ATTESTATION_SUBNET_COUNT
+            seen.add(int(subnet))
+    # distinct (slot, committee) pairs spread over subnets
+    assert len(seen) == min(
+        int(spec.SLOTS_PER_EPOCH * committees_per_slot),
+        int(spec.ATTESTATION_SUBNET_COUNT),
+    )
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_aggregator_selection_is_deterministic(spec, state):
+    slot = state.slot
+    committee_index = spec.CommitteeIndex(0)
+    any_aggregator = False
+    committee = spec.get_beacon_committee(state, slot, committee_index)
+    for index in committee:
+        sig = spec.get_slot_signature(state, slot, privkeys[index])
+        a = spec.is_aggregator(state, slot, committee_index, sig)
+        b = spec.is_aggregator(state, slot, committee_index, sig)
+        assert a == b
+        any_aggregator |= a
+    # with modulo = max(1, len//16) and minimal committees, someone aggregates
+    assert any_aggregator
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_get_aggregate_and_proof_signature_verifies(spec, state):
+    next_epoch(spec, state)
+    attestation = get_valid_attestation(
+        spec, state, slot=state.slot - 1, signed=True
+    )
+    aggregator_index = spec.get_attesting_indices(
+        state, attestation.data, attestation.aggregation_bits
+    ).pop()
+    privkey = privkeys[aggregator_index]
+    aap = spec.get_aggregate_and_proof(state, aggregator_index, attestation, privkey)
+    assert aap.aggregator_index == aggregator_index
+    assert aap.aggregate == attestation
+    signature = spec.get_aggregate_and_proof_signature(state, aap, privkey)
+    domain = spec.get_domain(
+        state, spec.DOMAIN_AGGREGATE_AND_PROOF,
+        spec.compute_epoch_at_slot(attestation.data.slot),
+    )
+    signing_root = spec.compute_signing_root(aap, domain)
+    assert spec.bls.Verify(pubkeys[aggregator_index], signing_root, signature)
+
+
+@with_all_phases
+@spec_state_test
+def test_get_eth1_vote_default_and_majority(spec, state):
+    follow_window = int(
+        spec.config.SECONDS_PER_ETH1_BLOCK * spec.config.ETH1_FOLLOW_DISTANCE
+    )
+    # genesis_time of 0 puts the whole follow window before the epoch;
+    # shift it so candidate blocks can exist
+    state.genesis_time = 3 * follow_window
+    period_start = spec.voting_period_start_time(state)
+    # no candidate blocks: default vote is the state's own eth1_data
+    assert spec.get_eth1_vote(state, []) == state.eth1_data
+
+    follow = int(spec.config.SECONDS_PER_ETH1_BLOCK * spec.config.ETH1_FOLLOW_DISTANCE)
+    blocks = [
+        spec.Eth1Block(
+            timestamp=max(0, int(period_start) - follow - i),
+            deposit_root=bytes([i]) * 32,
+            deposit_count=state.eth1_data.deposit_count,
+        )
+        for i in range(1, 4)
+    ]
+    vote = spec.get_eth1_vote(state, blocks)
+    # with no prior votes, the default is the latest candidate in range
+    candidates = [
+        spec.get_eth1_data(b) for b in blocks
+        if spec.is_candidate_block(b, period_start)
+    ]
+    assert vote == candidates[-1]

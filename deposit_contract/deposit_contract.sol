@@ -1,0 +1,144 @@
+// SPDX-License-Identifier: CC0-1.0
+pragma solidity 0.6.11;
+
+// Own implementation of the eth2 deposit contract for this framework
+// (capability parity with the reference's solidity_deposit_contract/
+// deposit_contract.sol and specs/phase0/deposit-contract.md): a 32-depth
+// incremental sha256 Merkle accumulator over DepositData leaves whose root
+// the consensus spec checks with is_valid_merkle_branch
+// (reference specs/phase0/beacon-chain.md:737-750, 1852-1860).
+
+interface IDepositContract {
+    event DepositEvent(
+        bytes pubkey,
+        bytes withdrawal_credentials,
+        bytes amount,
+        bytes signature,
+        bytes index
+    );
+
+    function deposit(
+        bytes calldata pubkey,
+        bytes calldata withdrawal_credentials,
+        bytes calldata signature,
+        bytes32 deposit_data_root
+    ) external payable;
+
+    function get_deposit_root() external view returns (bytes32);
+
+    function get_deposit_count() external view returns (bytes memory);
+}
+
+interface ERC165 {
+    function supportsInterface(bytes4 interfaceId) external pure returns (bool);
+}
+
+contract DepositContract is IDepositContract, ERC165 {
+    uint constant TREE_DEPTH = 32;
+    // bounded strictly below 2**TREE_DEPTH so the length mix-in never wraps
+    uint constant MAX_DEPOSITS = 2**TREE_DEPTH - 1;
+
+    // branch[h] caches the left sibling pending at height h; only the
+    // path of the NEXT insertion is stored — O(depth) state, O(depth) insert
+    bytes32[TREE_DEPTH] branch;
+    uint256 deposit_count;
+
+    bytes32[TREE_DEPTH] zero_hashes;
+
+    constructor() public {
+        // zero_hashes[h] = root of an empty subtree of height h
+        for (uint h = 0; h < TREE_DEPTH - 1; h++)
+            zero_hashes[h + 1] = sha256(abi.encodePacked(zero_hashes[h], zero_hashes[h]));
+    }
+
+    function get_deposit_root() override external view returns (bytes32) {
+        bytes32 node;
+        uint size = deposit_count;
+        for (uint h = 0; h < TREE_DEPTH; h++) {
+            if ((size & 1) == 1)
+                node = sha256(abi.encodePacked(branch[h], node));
+            else
+                node = sha256(abi.encodePacked(node, zero_hashes[h]));
+            size /= 2;
+        }
+        // mix in the leaf count (SSZ List semantics)
+        return sha256(abi.encodePacked(
+            node,
+            to_little_endian_64(uint64(deposit_count)),
+            bytes24(0)
+        ));
+    }
+
+    function get_deposit_count() override external view returns (bytes memory) {
+        return to_little_endian_64(uint64(deposit_count));
+    }
+
+    function deposit(
+        bytes calldata pubkey,
+        bytes calldata withdrawal_credentials,
+        bytes calldata signature,
+        bytes32 deposit_data_root
+    ) override external payable {
+        require(pubkey.length == 48, "DepositContract: invalid pubkey length");
+        require(withdrawal_credentials.length == 32,
+            "DepositContract: invalid withdrawal_credentials length");
+        require(signature.length == 96, "DepositContract: invalid signature length");
+
+        require(msg.value >= 1 ether, "DepositContract: deposit value too low");
+        require(msg.value % 1 gwei == 0,
+            "DepositContract: deposit value not multiple of gwei");
+        uint deposit_amount = msg.value / 1 gwei;
+        require(deposit_amount <= type(uint64).max,
+            "DepositContract: deposit value too high");
+
+        emit DepositEvent(
+            pubkey,
+            withdrawal_credentials,
+            to_little_endian_64(uint64(deposit_amount)),
+            signature,
+            to_little_endian_64(uint64(deposit_count))
+        );
+
+        // DepositData hash_tree_root, computed exactly as the SSZ spec does
+        bytes32 pubkey_root = sha256(abi.encodePacked(pubkey, bytes16(0)));
+        bytes32 signature_root = sha256(abi.encodePacked(
+            sha256(abi.encodePacked(signature[:64])),
+            sha256(abi.encodePacked(signature[64:], bytes32(0)))
+        ));
+        bytes32 node = sha256(abi.encodePacked(
+            sha256(abi.encodePacked(pubkey_root, withdrawal_credentials)),
+            sha256(abi.encodePacked(
+                to_little_endian_64(uint64(deposit_amount)), bytes24(0), signature_root
+            ))
+        ));
+        require(node == deposit_data_root,
+            "DepositContract: reconstructed DepositData does not match supplied deposit_data_root");
+
+        require(deposit_count < MAX_DEPOSITS, "DepositContract: merkle tree full");
+        deposit_count += 1;
+
+        // incremental insert: carry up until an empty (even) slot
+        uint size = deposit_count;
+        for (uint h = 0; h < TREE_DEPTH; h++) {
+            if ((size & 1) == 1) {
+                branch[h] = node;
+                return;
+            }
+            node = sha256(abi.encodePacked(branch[h], node));
+            size /= 2;
+        }
+        assert(false);
+    }
+
+    function supportsInterface(bytes4 interfaceId) override external pure returns (bool) {
+        return interfaceId == type(ERC165).interfaceId
+            || interfaceId == type(IDepositContract).interfaceId;
+    }
+
+    function to_little_endian_64(uint64 value) internal pure returns (bytes memory ret) {
+        ret = new bytes(8);
+        for (uint i = 0; i < 8; i++) {
+            ret[i] = bytes1(uint8(value >> (8 * i)));
+        }
+    }
+}

@@ -1,0 +1,125 @@
+"""Deposit-contract model vs the consensus spec
+(consensus_specs_tpu/deposit_contract/model.py twin of
+deposit_contract/deposit_contract.sol; reference
+specs/phase0/deposit-contract.md + beacon-chain.md:1835-1887)."""
+from random import Random
+
+from consensus_specs_tpu.builder import build_spec_module
+from consensus_specs_tpu.deposit_contract import DepositContractModel
+from consensus_specs_tpu.utils import bls
+
+
+def _spec():
+    return build_spec_module("phase0", "minimal")
+
+
+def _deposit_datas(spec, n, rng):
+    out = []
+    for i in range(n):
+        sk = i + 1
+        out.append(spec.DepositData(
+            pubkey=bls.SkToPk(sk),
+            withdrawal_credentials=bytes([i]) * 32,
+            amount=spec.MAX_EFFECTIVE_BALANCE,
+            signature=bytes(rng.getrandbits(8) for _ in range(96)),
+        ))
+    return out
+
+
+def test_incremental_root_matches_ssz_list_root():
+    """The contract's accumulated root equals hash_tree_root of the spec's
+    List[DepositData, 2**32] of leaf roots at every prefix length."""
+    spec = _spec()
+    rng = Random(31)
+    datas = _deposit_datas(spec, 9, rng)
+    model = DepositContractModel()
+    leaf_list_type = spec.List[spec.DepositData, 2**spec.DEPOSIT_CONTRACT_TREE_DEPTH]
+    for i, dd in enumerate(datas):
+        model.deposit(spec.hash_tree_root(dd))
+        ssz_root = spec.hash_tree_root(leaf_list_type(*datas[: i + 1]))
+        assert model.get_deposit_root() == ssz_root
+        assert model.get_deposit_count() == (i + 1).to_bytes(8, "little")
+
+
+def test_proofs_verify_with_is_valid_merkle_branch():
+    spec = _spec()
+    rng = Random(32)
+    datas = _deposit_datas(spec, 7, rng)
+    model = DepositContractModel()
+    for dd in datas:
+        model.deposit(spec.hash_tree_root(dd))
+    root = model.get_deposit_root()
+    for index, dd in enumerate(datas):
+        proof = model.proof_at(index)
+        assert len(proof) == spec.DEPOSIT_CONTRACT_TREE_DEPTH + 1
+        assert spec.is_valid_merkle_branch(
+            leaf=spec.hash_tree_root(dd),
+            branch=proof,
+            depth=spec.DEPOSIT_CONTRACT_TREE_DEPTH + 1,
+            index=index,
+            root=root,
+        )
+    # a proof against a longer tree state must also verify for old leaves
+    # only when recomputed for that state
+    proof_old = model.proof_at(0, deposit_count=3)
+    partial = DepositContractModel()
+    for dd in datas[:3]:
+        partial.deposit(spec.hash_tree_root(dd))
+    assert spec.is_valid_merkle_branch(
+        leaf=spec.hash_tree_root(datas[0]),
+        branch=proof_old,
+        depth=spec.DEPOSIT_CONTRACT_TREE_DEPTH + 1,
+        index=0,
+        root=partial.get_deposit_root(),
+    )
+
+
+def test_end_to_end_process_deposit():
+    """Contract accumulator -> proof -> spec.process_deposit applies it."""
+    from consensus_specs_tpu.test.helpers.genesis import create_genesis_state
+    from consensus_specs_tpu.test.helpers.keys import privkeys, pubkeys
+
+    spec = _spec()
+    bls.bls_active = True
+    try:
+        state = create_genesis_state(
+            spec, [spec.MAX_EFFECTIVE_BALANCE] * 8, spec.MAX_EFFECTIVE_BALANCE
+        )
+        new_index = len(state.validators)
+        sk, pk = privkeys[new_index], pubkeys[new_index]
+        withdrawal_credentials = (
+            spec.BLS_WITHDRAWAL_PREFIX + spec.hash(pk)[1:]
+        )
+        deposit_message = spec.DepositMessage(
+            pubkey=pk,
+            withdrawal_credentials=withdrawal_credentials,
+            amount=spec.MAX_EFFECTIVE_BALANCE,
+        )
+        domain = spec.compute_domain(spec.DOMAIN_DEPOSIT)
+        signature = bls.Sign(sk, spec.compute_signing_root(deposit_message, domain))
+        deposit_data = spec.DepositData(
+            pubkey=pk,
+            withdrawal_credentials=withdrawal_credentials,
+            amount=spec.MAX_EFFECTIVE_BALANCE,
+            signature=signature,
+        )
+
+        model = DepositContractModel()
+        model.deposit(spec.hash_tree_root(deposit_data))
+
+        # the beacon state trusts the contract root via eth1 data
+        state.eth1_data = spec.Eth1Data(
+            deposit_root=model.get_deposit_root(),
+            deposit_count=model.deposit_count,
+            block_hash=b"\x22" * 32,
+        )
+        state.eth1_deposit_index = 0
+
+        deposit = spec.Deposit(proof=model.proof_at(0), data=deposit_data)
+        pre_count = len(state.validators)
+        spec.process_deposit(state, deposit)
+        assert len(state.validators) == pre_count + 1
+        assert state.validators[new_index].pubkey == pk
+        assert state.balances[new_index] == spec.MAX_EFFECTIVE_BALANCE
+    finally:
+        bls.bls_active = True
