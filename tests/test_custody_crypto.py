@@ -1,0 +1,57 @@
+"""Custody-game crypto primitives (utils/custody.py; reference
+specs/custody_game/beacon-chain.md:258-335)."""
+from random import Random
+
+from consensus_specs_tpu.utils import bls, custody
+
+
+def test_legendre_bit_matches_euler_criterion():
+    rng = Random(55)
+    q = custody.CUSTODY_PRIME
+    for _ in range(20):
+        a = rng.randrange(1, q)
+        euler = pow(a, (q - 1) // 2, q)
+        want = 1 if euler == 1 else 0
+        assert custody.legendre_bit(a, q) == want
+    assert custody.legendre_bit(0, q) == 0
+    assert custody.legendre_bit(q + 4, q) == custody.legendre_bit(4, q)
+    # small prime sanity: QRs mod 7 are {1,2,4}
+    assert [custody.legendre_bit(a, 7) for a in range(1, 7)] == [1, 1, 0, 1, 0, 0]
+
+
+def test_custody_atoms_padding():
+    atoms = custody.get_custody_atoms(b"\x01" * 33)
+    assert len(atoms) == 2
+    assert atoms[0] == b"\x01" * 32
+    assert atoms[1] == b"\x01" + b"\x00" * 31
+    assert custody.get_custody_atoms(b"") == []
+
+
+def test_custody_secrets_shape():
+    sig = bls.Sign(7, b"\x03" * 32)
+    secrets = custody.get_custody_secrets(sig)
+    assert len(secrets) == 3  # 96 bytes of x-coordinate in 32-byte chunks
+    assert all(0 <= s < 2**256 for s in secrets)
+    # deterministic per signature
+    assert secrets == custody.get_custody_secrets(sig)
+
+
+def test_compute_custody_bit_deterministic_and_key_sensitive():
+    data = bytes(Random(8).getrandbits(8) for _ in range(512))
+    key_a = bls.Sign(11, b"\x01" * 32)
+    key_b = bls.Sign(12, b"\x01" * 32)
+    bit_a = custody.compute_custody_bit(key_a, data)
+    assert bit_a in (0, 1)
+    assert custody.compute_custody_bit(key_a, data) == bit_a
+    # with 10 legendre bits, bit=1 has probability ~2^-10: a different key
+    # virtually always gives 0; both keys giving 1 would be astonishing
+    assert not (bit_a == 1 and custody.compute_custody_bit(key_b, data) == 1)
+
+
+def test_universal_hash_function_linearity_breaks():
+    # UHF must distinguish atom order (it's a polynomial evaluation)
+    secrets = [3, 5, 7]
+    a = [b"\x01" + b"\x00" * 31, b"\x02" + b"\x00" * 31]
+    b = [a[1], a[0]]
+    assert custody.universal_hash_function(a, secrets) != \
+        custody.universal_hash_function(b, secrets)

@@ -1,0 +1,111 @@
+"""KZG commitments + DAS erasure coding (utils/kzg.py; reference
+specs/das/das-core.md:63-190, specs/sharding/beacon-chain.md:717-721)."""
+from random import Random
+
+import pytest
+
+from consensus_specs_tpu.utils import kzg
+from consensus_specs_tpu.utils.kzg import MODULUS
+
+RNG = Random(1717)
+N = 16  # polynomial/evaluation domain size for the tests
+SETUP = kzg.Setup(tau=RNG.randrange(2, MODULUS), n=2 * N)
+
+
+def _random_data(n):
+    return [RNG.randrange(MODULUS) for _ in range(n)]
+
+
+def test_fft_matches_naive_evaluation():
+    coeffs = _random_data(8)
+    omega = kzg.root_of_unity(8)
+    evals = kzg.fft(coeffs)
+    for i in range(8):
+        x = pow(omega, i, MODULUS)
+        want = sum(c * pow(x, k, MODULUS) for k, c in enumerate(coeffs)) % MODULUS
+        assert evals[i] == want
+
+
+def test_fft_ifft_roundtrip():
+    coeffs = _random_data(N)
+    assert kzg.inverse_fft(kzg.fft(coeffs)) == coeffs
+
+
+def test_das_extension_halves_are_consistent():
+    # the defining property: IFFT of the reverse-bit-ordered extended data
+    # has an all-zero second half (das-core.md:89-97, 113-121)
+    data = _random_data(N)
+    extended = kzg.extend_data(data)
+    assert extended[:N] == data
+    poly = kzg.inverse_fft(kzg.reverse_bit_order_list(extended))
+    assert all(c == 0 for c in poly[N:])
+    assert kzg.unextend_data(extended) == data
+
+
+@pytest.mark.parametrize("missing", [[0], [1, 3], [0, 2, 5, 7]])
+def test_recover_data(missing):
+    # split the extended data into 8 subgroups, drop up to half, recover
+    data = _random_data(N)
+    extended = kzg.extend_data(data)
+    rbo = kzg.reverse_bit_order_list(extended)
+    points_per = len(rbo) // 8
+    subgroups = [rbo[i * points_per:(i + 1) * points_per] for i in range(8)]
+    damaged = [None if i in missing else s for i, s in enumerate(subgroups)]
+    recovered = kzg.recover_data(damaged)
+    assert recovered == rbo
+
+
+def test_recover_data_rejects_inconsistent_samples():
+    data = _random_data(N)
+    rbo = kzg.reverse_bit_order_list(kzg.extend_data(data))
+    points_per = len(rbo) // 8
+    subgroups = [list(rbo[i * points_per:(i + 1) * points_per]) for i in range(8)]
+    subgroups[7][0] = (subgroups[7][0] + 1) % MODULUS  # corrupt one point
+    with pytest.raises(AssertionError):
+        kzg.recover_data(subgroups)
+
+
+def test_kzg_single_point_proof():
+    coeffs = _random_data(N)
+    commitment = kzg.commit_to_poly(SETUP, coeffs)
+    z = RNG.randrange(MODULUS)
+    proof, y = kzg.prove_at_point(SETUP, coeffs, z)
+    assert kzg.verify_point_proof(SETUP, commitment, proof, z, y)
+    assert not kzg.verify_point_proof(SETUP, commitment, proof, z, (y + 1) % MODULUS)
+    assert not kzg.verify_point_proof(SETUP, commitment, proof, (z + 1) % MODULUS, y)
+
+
+def test_kzg_coset_multi_proof():
+    # one DAS sample: a coset of size 4 out of the N-point domain
+    coeffs = _random_data(N)
+    commitment = kzg.commit_to_poly(SETUP, coeffs)
+    coset_size = 4
+    x = pow(kzg.root_of_unity(N), 3, MODULUS)  # an arbitrary domain point
+    proof, ys = kzg.prove_coset(SETUP, coeffs, x, coset_size)
+    assert kzg.check_multi_kzg_proof(SETUP, commitment, proof, x, ys)
+    bad_ys = list(ys)
+    bad_ys[0] = (bad_ys[0] + 1) % MODULUS
+    assert not kzg.check_multi_kzg_proof(SETUP, commitment, proof, x, bad_ys)
+
+
+def test_commit_to_data_matches_commit_to_poly():
+    data = _random_data(N)
+    poly = kzg.inverse_fft(kzg.reverse_bit_order_list(data))
+    from consensus_specs_tpu.utils.bls12_381 import ec_eq
+
+    assert ec_eq(
+        kzg.commit_to_data(SETUP, data), kzg.commit_to_poly(SETUP, poly)
+    )
+
+
+def test_sharding_degree_proof():
+    # (reference specs/sharding/beacon-chain.md:717-721)
+    points_count = N
+    coeffs = _random_data(points_count)
+    commitment = kzg.commit_to_poly(SETUP, coeffs)
+    dproof = kzg.degree_proof(SETUP, coeffs, points_count)
+    assert kzg.verify_degree_proof(SETUP, commitment, dproof, points_count)
+    # a polynomial of HIGHER degree cannot satisfy the bound's proof shape:
+    # reusing the same degree_proof with a different commitment must fail
+    other = kzg.commit_to_poly(SETUP, _random_data(2 * N))
+    assert not kzg.verify_degree_proof(SETUP, other, dproof, points_count)
