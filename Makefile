@@ -4,7 +4,7 @@
 VECTORS_DIR ?= ../consensus-spec-tests/tests
 PYTEST = JAX_PLATFORMS=cpu python -m pytest
 
-GENERATORS = operations sanity epoch_processing rewards finality forks \
+GENERATORS = operations sanity epoch_processing rewards finality forks transition \
              fork_choice ssz_static shuffling bls genesis
 
 .PHONY: test citest test_tpu_backend lint generate_tests \
@@ -48,3 +48,7 @@ multichip:
 
 clean_vectors:
 	rm -rf $(VECTORS_DIR)
+
+# build the native batched-SHA256 merkleization kernel (csrc/)
+native:
+	gcc -O3 -fPIC -shared -o csrc/libsha256_batch.so csrc/sha256_batch.c

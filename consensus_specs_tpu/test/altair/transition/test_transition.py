@@ -1,0 +1,77 @@
+"""Cross-fork transition tests: chains that live through an upgrade
+(machinery: context.fork_transition_test + helpers/fork_transition.py;
+reference altair/transition suite + specs/altair/fork.md:36-38,
+specs/merge/fork.md)."""
+from ...context import ALTAIR, MERGE, PHASE0, fork_transition_test
+from ...helpers.block import build_empty_block_for_next_slot
+from ...helpers.fork_transition import (
+    do_fork, transition_to_next_epoch_and_append_blocks, transition_until_fork,
+)
+from ...helpers.state import state_transition_and_sign_block
+
+
+def _run_normal_transition(spec, post_spec, state, fork_epoch):
+    yield 'pre', state
+    blocks = []
+    # pre-fork epochs of empty blocks
+    while spec.get_current_epoch(state) < fork_epoch - 1 or (
+        (state.slot + 2) % spec.SLOTS_PER_EPOCH != 0
+    ):
+        block = build_empty_block_for_next_slot(spec, state)
+        blocks.append(state_transition_and_sign_block(spec, state, block))
+        if state.slot + 1 == fork_epoch * spec.SLOTS_PER_EPOCH:
+            break
+
+    pre_validators_root = state.genesis_validators_root
+    pre_validator_count = len(state.validators)
+    state, fork_block = do_fork(state, spec, post_spec, fork_epoch)
+    assert fork_block is not None
+    blocks.append(fork_block)
+    assert state.fork.current_version == _version(post_spec)
+    # identity carried across the upgrade
+    assert state.genesis_validators_root == pre_validators_root
+    assert len(state.validators) == pre_validator_count
+
+    # a full post-fork epoch keeps transitioning fine
+    state = transition_to_next_epoch_and_append_blocks(post_spec, state, blocks)
+    assert post_spec.get_current_epoch(state) == fork_epoch + 1
+    yield 'blocks', blocks
+    yield 'post', state
+
+
+def _version(post_spec):
+    return {
+        ALTAIR: post_spec.config.ALTAIR_FORK_VERSION,
+        MERGE: post_spec.config.MERGE_FORK_VERSION,
+    }[post_spec.fork]
+
+
+@fork_transition_test(PHASE0, ALTAIR, fork_epoch=2)
+def test_normal_transition_to_altair(spec, post_spec, state, fork_epoch, phases):
+    yield from _run_normal_transition(spec, post_spec, state, fork_epoch)
+
+
+@fork_transition_test(PHASE0, ALTAIR, fork_epoch=1)
+def test_transition_to_altair_at_epoch_1(spec, post_spec, state, fork_epoch, phases):
+    yield from _run_normal_transition(spec, post_spec, state, fork_epoch)
+
+
+@fork_transition_test(ALTAIR, MERGE, fork_epoch=2)
+def test_normal_transition_to_merge(spec, post_spec, state, fork_epoch, phases):
+    yield from _run_normal_transition(spec, post_spec, state, fork_epoch)
+
+
+@fork_transition_test(PHASE0, ALTAIR, fork_epoch=2)
+def test_transition_no_block_at_fork_slot(spec, post_spec, state, fork_epoch, phases):
+    """The upgrade happens inside process_slots even when the fork slot
+    itself is empty (specs/altair/fork.md:36-38)."""
+    yield 'pre', state
+    transition_until_fork(spec, state, fork_epoch)
+    state, fork_block = do_fork(state, spec, post_spec, fork_epoch, with_block=False)
+    assert fork_block is None
+    assert state.fork.current_version == post_spec.config.ALTAIR_FORK_VERSION
+    blocks = []
+    state = transition_to_next_epoch_and_append_blocks(post_spec, state, blocks)
+    assert post_spec.get_current_epoch(state) == fork_epoch + 1
+    yield 'blocks', blocks
+    yield 'post', state

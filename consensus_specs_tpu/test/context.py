@@ -413,6 +413,63 @@ def only_generator(reason):
     return decorator
 
 
+def fork_transition_test(pre_fork_name, post_fork_name, fork_epoch=2):
+    """Run a test across an upgrade boundary: the test receives the PRE-fork
+    ``spec`` and ``state``, the POST-fork ``post_spec``, the ``fork_epoch``,
+    and a ``phases`` dict; both specs' configs carry the fork epoch for the
+    duration (reference context.py:484-516)."""
+
+    def deco(fn):
+        @_wraps(fn)
+        def wrapper(*args, **kw):
+            from ..builder import IMPLEMENTED_FORKS
+
+            only_phase = kw.pop("phase", None)
+            if only_phase is not None and only_phase != pre_fork_name:
+                return None
+            if pre_fork_name not in IMPLEMENTED_FORKS or post_fork_name not in IMPLEMENTED_FORKS:
+                import pytest
+
+                pytest.skip(f"{pre_fork_name}->{post_fork_name} not implemented")
+            preset = kw.pop("preset", DEFAULT_TEST_PRESET)
+            spec = build_spec_module(pre_fork_name, preset)
+            post_spec = build_spec_module(post_fork_name, preset)
+            epoch_attr = f"{post_fork_name.upper()}_FORK_EPOCH"
+
+            old_pre_config, old_post_config = spec.config, post_spec.config
+            for mod in (spec, post_spec):
+                new_config = mod.config.copy()
+                setattr(new_config, epoch_attr, mod.Epoch(fork_epoch))
+                mod.config = new_config
+            try:
+                state = get_genesis_state(
+                    spec, default_balances, default_activation_threshold
+                )
+                kw.update(
+                    spec=spec,
+                    post_spec=post_spec,
+                    state=state,
+                    fork_epoch=fork_epoch,
+                    phases={pre_fork_name: spec, post_fork_name: post_spec},
+                )
+                inner = spec_test(fn)
+                parts = inner(*args, **kw)
+                if kw.get("generator_mode") and parts is not None:
+                    parts = [
+                        ("fork", "meta", post_fork_name),
+                        ("fork_epoch", "meta", int(fork_epoch)),
+                    ] + list(parts)
+                return parts
+            finally:
+                spec.config = old_pre_config
+                post_spec.config = old_post_config
+
+        wrapper.phases = [pre_fork_name]
+        return wrapper
+
+    return deco
+
+
 def spec_targets():
     from ..builder import spec_targets as _targets
 

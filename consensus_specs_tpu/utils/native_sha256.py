@@ -1,0 +1,73 @@
+"""ctypes binding for the native batched SHA-256 (csrc/sha256_batch.c).
+
+``hash_pairs(data) -> bytes`` hashes ``len(data)//64`` independent 64-byte
+messages in ONE native call — the merkleization inner loop
+(utils/merkle_minimal.py, utils/ssz/ssz_typing.py merkleize_chunks) calls it
+once per tree layer instead of once per node pair through hashlib.
+
+The shared object is built on demand (`make native`, or lazily here when a
+compiler is available); everything falls back to hashlib when it isn't —
+the native path is a throughput component, never a correctness dependency.
+"""
+import ctypes
+import hashlib
+import os
+import subprocess
+from pathlib import Path
+
+_REPO = Path(__file__).resolve().parents[2]
+_SRC = _REPO / "csrc" / "sha256_batch.c"
+_SO = _REPO / "csrc" / "libsha256_batch.so"
+
+_lib = None
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["gcc", "-O3", "-fPIC", "-shared", "-o", str(_SO), str(_SRC)],
+            check=True, capture_output=True, timeout=120,
+        )
+        return True
+    except Exception:
+        return False
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not _SO.exists():
+        if not (_SRC.exists() and _build()):
+            _lib = False
+            return _lib
+    try:
+        lib = ctypes.CDLL(str(_SO))
+        lib.sha256_hash_pairs.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
+        ]
+        lib.sha256_hash_pairs.restype = None
+        _lib = lib
+    except OSError:
+        _lib = False
+    return _lib
+
+
+def available() -> bool:
+    return bool(_load())
+
+
+def hash_pairs(data: bytes) -> bytes:
+    """SHA-256 of each consecutive 64-byte message in ``data``; returns the
+    concatenated 32-byte digests."""
+    n, rem = divmod(len(data), 64)
+    assert rem == 0, "hash_pairs input must be a whole number of 64-byte pairs"
+    lib = _load()
+    if not lib:
+        out = bytearray()
+        for i in range(n):
+            out += hashlib.sha256(data[64 * i: 64 * (i + 1)]).digest()
+        return bytes(out)
+    buf = ctypes.create_string_buffer(32 * n)
+    lib.sha256_hash_pairs(data, buf, n)
+    return buf.raw

@@ -51,14 +51,32 @@ def merkleize_chunks(chunks: Sequence[bytes], limit: Optional[int] = None) -> by
         return ZERO_HASHES[depth]
     layer = list(chunks)
     for level in range(depth):
-        nxt = []
-        odd = len(layer) % 2 == 1
-        for i in range(len(layer) // 2):
-            nxt.append(sha256(layer[2 * i] + layer[2 * i + 1]).digest())
-        if odd:
-            nxt.append(sha256(layer[-1] + ZERO_HASHES[level]).digest())
-        layer = nxt
+        if len(layer) % 2 == 1:
+            layer.append(ZERO_HASHES[level])
+        n_pairs = len(layer) // 2
+        if n_pairs >= 8 and _native_hash_pairs is not None:
+            # one native call per LAYER (csrc/sha256_batch.c) instead of a
+            # hashlib round-trip per node pair
+            digests = _native_hash_pairs(b"".join(layer))
+            layer = [digests[32 * i: 32 * (i + 1)] for i in range(n_pairs)]
+        else:
+            layer = [
+                sha256(layer[2 * i] + layer[2 * i + 1]).digest()
+                for i in range(n_pairs)
+            ]
     return layer[0]
+
+
+def _load_native_hash_pairs():
+    try:
+        from ..native_sha256 import available, hash_pairs
+
+        return hash_pairs if available() else None
+    except Exception:
+        return None
+
+
+_native_hash_pairs = _load_native_hash_pairs()
 
 
 def mix_in_length(root: bytes, length: int) -> bytes:

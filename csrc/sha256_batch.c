@@ -1,0 +1,91 @@
+/* Batched SHA-256 for SSZ merkleization — the native runtime component
+ * backing hash_tree_root throughput (SURVEY.md §7.3 hard part #6; the
+ * reference's native plane is its C BLS binding, utils/bls.py:17-22).
+ *
+ * API (ctypes, see consensus_specs_tpu/utils/native_sha256.py):
+ *   void sha256_hash_pairs(const uint8_t* in, uint8_t* out, size_t n)
+ *     - hashes n independent 64-byte messages (pairs of 32-byte tree nodes)
+ *       into n 32-byte digests: one C call per MERKLE LAYER instead of one
+ *       Python hashlib call per node pair. Every message is exactly one
+ *       data block + one constant padding block, so the whole layer runs
+ *       without branching or allocation.
+ *
+ * Build: make native (gcc -O3 -fPIC -shared).
+ */
+#include <stdint.h>
+#include <stddef.h>
+#include <string.h>
+
+static const uint32_t K[64] = {
+    0x428a2f98,0x71374491,0xb5c0fbcf,0xe9b5dba5,0x3956c25b,0x59f111f1,
+    0x923f82a4,0xab1c5ed5,0xd807aa98,0x12835b01,0x243185be,0x550c7dc3,
+    0x72be5d74,0x80deb1fe,0x9bdc06a7,0xc19bf174,0xe49b69c1,0xefbe4786,
+    0x0fc19dc6,0x240ca1cc,0x2de92c6f,0x4a7484aa,0x5cb0a9dc,0x76f988da,
+    0x983e5152,0xa831c66d,0xb00327c8,0xbf597fc7,0xc6e00bf3,0xd5a79147,
+    0x06ca6351,0x14292967,0x27b70a85,0x2e1b2138,0x4d2c6dfc,0x53380d13,
+    0x650a7354,0x766a0abb,0x81c2c92e,0x92722c85,0xa2bfe8a1,0xa81a664b,
+    0xc24b8b70,0xc76c51a3,0xd192e819,0xd6990624,0xf40e3585,0x106aa070,
+    0x19a4c116,0x1e376c08,0x2748774c,0x34b0bcb5,0x391c0cb3,0x4ed8aa4a,
+    0x5b9cca4f,0x682e6ff3,0x748f82ee,0x78a5636f,0x84c87814,0x8cc70208,
+    0x90befffa,0xa4506ceb,0xbef9a3f7,0xc67178f2
+};
+
+#define ROR(x,n) (((x) >> (n)) | ((x) << (32 - (n))))
+
+static void compress(uint32_t state[8], const uint8_t block[64]) {
+    uint32_t w[64];
+    for (int i = 0; i < 16; i++)
+        w[i] = ((uint32_t)block[4*i] << 24) | ((uint32_t)block[4*i+1] << 16)
+             | ((uint32_t)block[4*i+2] << 8) | block[4*i+3];
+    for (int i = 16; i < 64; i++) {
+        uint32_t s0 = ROR(w[i-15], 7) ^ ROR(w[i-15], 18) ^ (w[i-15] >> 3);
+        uint32_t s1 = ROR(w[i-2], 17) ^ ROR(w[i-2], 19) ^ (w[i-2] >> 10);
+        w[i] = w[i-16] + s0 + w[i-7] + s1;
+    }
+    uint32_t a = state[0], b = state[1], c = state[2], d = state[3];
+    uint32_t e = state[4], f = state[5], g = state[6], h = state[7];
+    for (int i = 0; i < 64; i++) {
+        uint32_t S1 = ROR(e, 6) ^ ROR(e, 11) ^ ROR(e, 25);
+        uint32_t ch = (e & f) ^ (~e & g);
+        uint32_t t1 = h + S1 + ch + K[i] + w[i];
+        uint32_t S0 = ROR(a, 2) ^ ROR(a, 13) ^ ROR(a, 22);
+        uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+        uint32_t t2 = S0 + maj;
+        h = g; g = f; f = e; e = d + t1;
+        d = c; c = b; b = a; a = t1 + t2;
+    }
+    state[0] += a; state[1] += b; state[2] += c; state[3] += d;
+    state[4] += e; state[5] += f; state[6] += g; state[7] += h;
+}
+
+static const uint32_t IV[8] = {
+    0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+    0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19
+};
+
+/* the padding block for a 64-byte message is constant: 0x80, zeros, and the
+ * 512-bit length in the trailing 8 bytes */
+static const uint8_t PAD64[64] = {
+    0x80, 0, 0, 0, 0, 0, 0, 0,  0, 0, 0, 0, 0, 0, 0, 0,
+    0,    0, 0, 0, 0, 0, 0, 0,  0, 0, 0, 0, 0, 0, 0, 0,
+    0,    0, 0, 0, 0, 0, 0, 0,  0, 0, 0, 0, 0, 0, 0, 0,
+    0,    0, 0, 0, 0, 0, 0, 0,  0, 0, 0, 0, 0, 0, 0x02, 0x00
+};
+
+static void sha256_64(const uint8_t in[64], uint8_t out[32]) {
+    uint32_t st[8];
+    memcpy(st, IV, sizeof st);
+    compress(st, in);
+    compress(st, PAD64);
+    for (int i = 0; i < 8; i++) {
+        out[4*i]   = (uint8_t)(st[i] >> 24);
+        out[4*i+1] = (uint8_t)(st[i] >> 16);
+        out[4*i+2] = (uint8_t)(st[i] >> 8);
+        out[4*i+3] = (uint8_t)(st[i]);
+    }
+}
+
+void sha256_hash_pairs(const uint8_t* in, uint8_t* out, size_t n) {
+    for (size_t i = 0; i < n; i++)
+        sha256_64(in + 64 * i, out + 32 * i);
+}

@@ -1,0 +1,1 @@
+from consensus_specs_tpu.test.altair.transition.test_transition import *  # noqa: F401,F403
