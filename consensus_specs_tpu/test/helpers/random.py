@@ -1,0 +1,108 @@
+"""Seeded state/block randomizers for property-style scenarios.
+
+Own design; fills the role of the reference's test/helpers/random.py (200
+LoC) + test/utils/randomized_block_tests.py scenario vocabulary: mutate the
+state into unusual-but-legal shapes, then drive full transitions with
+randomly composed blocks and let the spec's own asserts be the oracle.
+"""
+from .attestations import get_valid_attestation
+from .block import build_empty_block_for_next_slot
+from .forks import is_post_altair
+from .state import state_transition_and_sign_block
+from .voluntary_exits import prepare_signed_exits
+
+
+def randomize_balances(spec, state, rng):
+    for i in range(len(state.validators)):
+        roll = rng.random()
+        if roll < 0.1:
+            state.balances[i] = spec.Gwei(0)
+        elif roll < 0.3:
+            state.balances[i] = spec.Gwei(
+                rng.randrange(int(spec.config.EJECTION_BALANCE))
+            )
+        else:
+            state.balances[i] = spec.Gwei(
+                rng.randrange(int(spec.MAX_EFFECTIVE_BALANCE * 2))
+            )
+
+
+def randomize_effective_balances(spec, state, rng):
+    increment = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    for v in state.validators:
+        v.effective_balance = spec.Gwei(
+            rng.randrange(0, int(spec.MAX_EFFECTIVE_BALANCE) + increment, increment)
+        )
+
+
+def slash_random_validators(spec, state, rng, fraction=0.1):
+    out = []
+    for i in range(len(state.validators)):
+        if rng.random() < fraction:
+            spec.slash_validator(state, spec.ValidatorIndex(i))
+            out.append(i)
+    return out
+
+
+def randomize_participation(spec, state, rng):
+    if is_post_altair(spec):
+        n = len(state.validators)
+        state.previous_epoch_participation = [
+            spec.ParticipationFlags(rng.randrange(8)) for _ in range(n)
+        ]
+        state.current_epoch_participation = [
+            spec.ParticipationFlags(rng.randrange(8)) for _ in range(n)
+        ]
+        state.inactivity_scores = [
+            spec.uint64(rng.randrange(0, 50)) for _ in range(n)
+        ]
+
+
+def random_block(spec, state, rng, exited: set):
+    """A valid-by-construction block carrying a random operation mix."""
+    block = build_empty_block_for_next_slot(spec, state)
+    # random attestations for an includable slot
+    if state.slot >= spec.MIN_ATTESTATION_INCLUSION_DELAY and rng.random() < 0.8:
+        slot_to_attest = state.slot - spec.MIN_ATTESTATION_INCLUSION_DELAY + 1
+        if slot_to_attest >= spec.compute_start_slot_at_epoch(
+            spec.get_current_epoch(state)
+        ):
+            def sample(participants):
+                return set(v for v in participants if rng.random() < 0.8)
+
+            attestation = get_valid_attestation(
+                spec, state, slot=slot_to_attest, signed=True,
+                filter_participant_set=sample,
+            )
+            if any(attestation.aggregation_bits):
+                block.body.attestations.append(attestation)
+    # occasional voluntary exit (requires enough validator age)
+    if rng.random() < 0.2:
+        current_epoch = spec.get_current_epoch(state)
+        eligible = [
+            i for i in spec.get_active_validator_indices(state, current_epoch)
+            if current_epoch >= state.validators[i].activation_epoch
+            + spec.config.SHARD_COMMITTEE_PERIOD
+            and i not in exited
+            and int(state.validators[i].exit_epoch) == int(spec.FAR_FUTURE_EPOCH)
+        ]
+        if eligible:
+            index = rng.choice(eligible)
+            block.body.voluntary_exits = prepare_signed_exits(spec, state, [index])
+            exited.add(index)
+    return block
+
+
+def run_random_scenario(spec, state, rng, slots):
+    """Drive ``slots`` of maybe-empty random blocks through the full
+    transition; the spec's asserts are the test oracle."""
+    exited: set = set()
+    signed_blocks = []
+    for _ in range(slots):
+        if rng.random() < 0.15:
+            # skipped slot
+            spec.process_slots(state, state.slot + 1)
+            continue
+        block = random_block(spec, state, rng, exited)
+        signed_blocks.append(state_transition_and_sign_block(spec, state, block))
+    return signed_blocks
