@@ -109,3 +109,33 @@ def test_sharding_degree_proof():
     # reusing the same degree_proof with a different commitment must fail
     other = kzg.commit_to_poly(SETUP, _random_data(2 * N))
     assert not kzg.verify_degree_proof(SETUP, other, dproof, points_count)
+
+
+def test_das_sampling_end_to_end():
+    """extend -> sample (multiproofs) -> verify each -> drop half ->
+    reconstruct (utils/das.py; das-core.md:113-190)."""
+    from consensus_specs_tpu.utils import das
+
+    data = _random_data(N)
+    extended = kzg.extend_data(data)
+    points_per_sample = 4
+    sample_count = len(extended) // points_per_sample
+    commitment = kzg.commit_to_poly(
+        SETUP, kzg.inverse_fft(kzg.reverse_bit_order_list(extended))
+    )
+    samples = das.sample_data(SETUP, extended, points_per_sample)
+    assert len(samples) == sample_count
+    for s in samples:
+        assert das.verify_sample(SETUP, s, sample_count, commitment)
+    # a corrupted sample fails verification
+    bad = das.DASSample(
+        index=samples[0].index, proof=samples[0].proof,
+        data=[(samples[0].data[0] + 1) % kzg.MODULUS] + list(samples[0].data[1:]),
+    )
+    assert not das.verify_sample(SETUP, bad, sample_count, commitment)
+    # reconstruct from half the samples
+    kept = [s if i % 2 == 0 else None for i, s in enumerate(samples)]
+    recovered = das.reconstruct_extended_data(
+        kept, sample_count, points_per_sample
+    )
+    assert recovered == list(extended)
