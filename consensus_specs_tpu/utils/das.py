@@ -32,8 +32,6 @@ def sample_data(setup: kzg.Setup, extended_data: Sequence[int],
     poly = kzg.inverse_fft(kzg.reverse_bit_order_list(list(extended_data)))
     assert all(c == 0 for c in poly[n // 2:])
 
-    omega_n = kzg.root_of_unity(n)
-    sample_root = pow(omega_n, sample_count, MODULUS)  # unused: doc parity
     samples = []
     for i in range(sample_count):
         x = _sample_x(n, sample_count, i)
@@ -42,7 +40,6 @@ def sample_data(setup: kzg.Setup, extended_data: Sequence[int],
         # the coset evaluations are exactly the reverse-bit-ordered sample
         assert ys == kzg.reverse_bit_order_list(data)
         samples.append(DASSample(index=i, proof=proof, data=data))
-    _ = sample_root
     return samples
 
 
@@ -63,6 +60,8 @@ def _sample_x(n: int, sample_count: int, index: int) -> int:
 def verify_sample(setup: kzg.Setup, sample: DASSample, sample_count: int,
                   commitment) -> bool:
     # (das-core.md:153-162)
+    if not 0 <= sample.index < sample_count:
+        return False  # reverse_bit_order would alias out-of-range indices
     n = sample_count * len(sample.data)
     x = _sample_x(n, sample_count, sample.index)
     ys = kzg.reverse_bit_order_list(list(sample.data))
@@ -78,22 +77,16 @@ def reconstruct_extended_data(
     slots: List[Optional[List[int]]] = [None] * sample_count
     for s in samples:
         if s is not None:
+            assert 0 <= s.index < sample_count, "sample index out of range"
             slots[s.index] = list(s.data)
     n = sample_count * points_per_sample
-    # recover in the naturally-ordered domain, then undo the ordering
-    natural_subgroups = []
-    flat: List[Optional[int]] = [None] * n
+    # map each known point to its NATURAL domain position, recover at the
+    # point level (sample boundaries don't align with natural-order chunks),
+    # then undo the ordering
+    rbo_known: List[Optional[int]] = [None] * n
     for i, sub in enumerate(slots):
         if sub is not None:
             for j, y in enumerate(sub):
-                flat[i * points_per_sample + j] = y
-    rbo_known: List[Optional[int]] = [None] * n
-    for i in range(n):
-        if flat[i] is not None:
-            rbo_known[kzg.reverse_bit_order(i, n)] = flat[i]
-    # regroup the natural vector into contiguous ranges for recover_data
-    for g in range(sample_count):
-        chunk = rbo_known[g * points_per_sample:(g + 1) * points_per_sample]
-        natural_subgroups.append(None if any(c is None for c in chunk) else chunk)
-    recovered_natural = kzg.recover_data(natural_subgroups)
+                rbo_known[kzg.reverse_bit_order(i * points_per_sample + j, n)] = y
+    recovered_natural = kzg.recover_data_points(rbo_known)
     return [recovered_natural[kzg.reverse_bit_order(i, n)] for i in range(n)]

@@ -123,33 +123,35 @@ def recover_data(subgroups: Sequence[Optional[Sequence[int]]]) -> List[int]:
             break
     assert points_per is not None
     n = sample_count * points_per
-    omega = root_of_unity(n)
-
-    # the input vector is NATURALLY ordered over the domain (what
-    # reverse_bit_order_list of the extended data yields): position i holds
-    # the evaluation at omega^i
-    known_x, known_y = [], []
+    flat: List[Optional[int]] = [None] * n
     for si, sub in enumerate(subgroups):
         if sub is None:
             continue
         for j, y in enumerate(sub):
-            i = si * points_per + j
-            known_x.append(pow(omega, i, MODULUS))
-            known_y.append(y % MODULUS)
-    assert len(known_x) >= n // 2, "need at least half the samples"
+            flat[si * points_per + j] = y
+    return recover_data_points(flat)
 
-    # interpolate the (degree < n/2) polynomial through n/2 known points
-    xs, ys = known_x[: n // 2], known_y[: n // 2]
+
+def recover_data_points(values: Sequence[Optional[int]]) -> List[int]:
+    """Point-level recovery: ``values[i]`` is the evaluation at omega^i or
+    None; any >= n/2 known points determine the (degree < n/2) polynomial.
+    Raises if the known points are mutually inconsistent."""
+    n = len(values)
+    assert is_power_of_two(n)
+    omega = root_of_unity(n)
+
+    known = [(i, v % MODULUS) for i, v in enumerate(values) if v is not None]
+    assert len(known) >= n // 2, "need at least half the points"
+
+    xs = [pow(omega, i, MODULUS) for i, _ in known[: n // 2]]
+    ys = [v for _, v in known[: n // 2]]
     coeffs = _lagrange_coeffs(xs, ys)
     assert len(coeffs) <= n // 2
     coeffs = coeffs + [0] * (n - len(coeffs))
     out = fft(coeffs, omega)
-    # consistency: recovered values must agree with every known sample
-    for si, sub in enumerate(subgroups):
-        if sub is None:
-            continue
-        for j, y in enumerate(sub):
-            assert out[si * points_per + j] == y % MODULUS, "inconsistent samples"
+    # consistency: recovered values must agree with EVERY known point
+    for i, v in known:
+        assert out[i] == v, "inconsistent samples"
     return out
 
 

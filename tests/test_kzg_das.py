@@ -120,9 +120,7 @@ def test_das_sampling_end_to_end():
     extended = kzg.extend_data(data)
     points_per_sample = 4
     sample_count = len(extended) // points_per_sample
-    commitment = kzg.commit_to_poly(
-        SETUP, kzg.inverse_fft(kzg.reverse_bit_order_list(extended))
-    )
+    commitment = kzg.commit_to_data(SETUP, extended)
     samples = das.sample_data(SETUP, extended, points_per_sample)
     assert len(samples) == sample_count
     for s in samples:
@@ -133,9 +131,20 @@ def test_das_sampling_end_to_end():
         data=[(samples[0].data[0] + 1) % kzg.MODULUS] + list(samples[0].data[1:]),
     )
     assert not das.verify_sample(SETUP, bad, sample_count, commitment)
-    # reconstruct from half the samples
-    kept = [s if i % 2 == 0 else None for i, s in enumerate(samples)]
-    recovered = das.reconstruct_extended_data(
-        kept, sample_count, points_per_sample
+    # out-of-range index: rejected, not aliased
+    oob = das.DASSample(
+        index=samples[0].index + sample_count, proof=samples[0].proof,
+        data=list(samples[0].data),
     )
-    assert recovered == list(extended)
+    assert not das.verify_sample(SETUP, oob, sample_count, commitment)
+    # reconstruct from half the samples — alternating AND contiguous drops
+    for keep in (
+        lambda i: i % 2 == 0,
+        lambda i: i < sample_count // 2,
+        lambda i: i >= sample_count // 2,
+    ):
+        kept = [s if keep(i) else None for i, s in enumerate(samples)]
+        recovered = das.reconstruct_extended_data(
+            kept, sample_count, points_per_sample
+        )
+        assert recovered == list(extended)
