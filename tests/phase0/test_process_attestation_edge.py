@@ -1,0 +1,1 @@
+from consensus_specs_tpu.test.phase0.block_processing.test_process_attestation_edge import *  # noqa: F401,F403
