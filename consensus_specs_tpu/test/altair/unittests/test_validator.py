@@ -1,0 +1,106 @@
+"""Altair validator-duty unit tests: sync-committee assignments, subnets,
+aggregation (spec: reference specs/altair/validator.md:70-424,
+specs/altair/p2p-interface.md:124-138)."""
+from ...context import ALTAIR, always_bls, spec_state_test, with_phases
+from ...helpers.keys import privkeys, pubkeys
+from ...helpers.sync_committee import get_committee_indices
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+def test_sync_committee_assignment_consistency(spec, state):
+    epoch = spec.get_current_epoch(state)
+    committee_members = set(get_committee_indices(spec, state))
+    for index in range(len(state.validators)):
+        assigned = spec.is_assigned_to_sync_committee(state, epoch, index)
+        assert assigned == (index in committee_members)
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+def test_compute_subnets_cover_all_seats(spec, state):
+    size = int(spec.SYNC_COMMITTEE_SIZE)
+    sub_size = size // int(spec.SYNC_COMMITTEE_SUBNET_COUNT)
+    committee_indices = get_committee_indices(spec, state)
+    for index in set(committee_indices):
+        subnets = spec.compute_subnets_for_sync_committee(state, index)
+        expected = {
+            spec.uint64(seat // sub_size)
+            for seat, v in enumerate(committee_indices) if v == index
+        }
+        assert set(int(s) for s in subnets) == set(int(s) for s in expected)
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+def test_get_sync_subcommittee_pubkeys_partition(spec, state):
+    # the subcommittee views tile the full committee exactly
+    all_pubkeys = []
+    for sub in range(int(spec.SYNC_COMMITTEE_SUBNET_COUNT)):
+        all_pubkeys.extend(spec.get_sync_subcommittee_pubkeys(state, spec.uint64(sub)))
+    assert list(all_pubkeys) == list(state.current_sync_committee.pubkeys)
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+@always_bls
+def test_sync_committee_message_verifies(spec, state):
+    block_root = spec.Root(b"\x77" * 32)
+    index = 0
+    msg = spec.get_sync_committee_message(state, block_root, index, privkeys[index])
+    assert msg.slot == state.slot
+    assert msg.beacon_block_root == block_root
+    domain = spec.get_domain(
+        state, spec.DOMAIN_SYNC_COMMITTEE, spec.get_current_epoch(state)
+    )
+    signing_root = spec.compute_signing_root(block_root, domain)
+    assert spec.bls.Verify(pubkeys[index], signing_root, msg.signature)
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+@always_bls
+def test_contribution_and_proof_flow(spec, state):
+    # contributions aggregate into the block's SyncAggregate shape
+    sub_size = int(spec.SYNC_COMMITTEE_SIZE) // int(spec.SYNC_COMMITTEE_SUBNET_COUNT)
+    contribution = spec.SyncCommitteeContribution(
+        slot=state.slot,
+        beacon_block_root=b"\x88" * 32,
+        subcommittee_index=1,
+        aggregation_bits=[True] * sub_size,
+        signature=spec.bls.Sign(privkeys[0], b"\x88" * 32),
+    )
+    cap = spec.get_contribution_and_proof(state, 0, contribution, privkeys[0])
+    assert cap.contribution == contribution
+    sig = spec.get_contribution_and_proof_signature(state, cap, privkeys[0])
+    domain = spec.get_domain(
+        state, spec.DOMAIN_CONTRIBUTION_AND_PROOF,
+        spec.compute_epoch_at_slot(contribution.slot),
+    )
+    assert spec.bls.Verify(
+        pubkeys[0], spec.compute_signing_root(cap, domain), sig
+    )
+
+    block = spec.BeaconBlock(slot=state.slot)
+    spec.process_sync_committee_contributions(block, {contribution})
+    bits = block.body.sync_aggregate.sync_committee_bits
+    assert sum(bits) == sub_size
+    # the set seats are exactly subcommittee 1's range
+    assert all(
+        bits[i] == (sub_size <= i < 2 * sub_size) for i in range(len(bits))
+    )
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+@always_bls
+def test_sync_committee_selection_deterministic(spec, state):
+    proofs = [
+        spec.get_sync_committee_selection_proof(state, state.slot, sub, privkeys[0])
+        for sub in range(int(spec.SYNC_COMMITTEE_SUBNET_COUNT))
+    ]
+    # distinct subcommittees sign distinct selection data
+    assert len(set(proofs)) == len(proofs)
+    for p in proofs:
+        a = spec.is_sync_committee_aggregator(p)
+        assert a == spec.is_sync_committee_aggregator(p)
