@@ -4,7 +4,7 @@
 VECTORS_DIR ?= ../consensus-spec-tests/tests
 PYTEST = JAX_PLATFORMS=cpu python -m pytest
 
-GENERATORS = operations sanity epoch_processing rewards finality forks transition \
+GENERATORS = operations sanity epoch_processing rewards finality forks transition random \
              fork_choice ssz_static ssz_generic shuffling bls genesis
 
 .PHONY: test citest test_tpu_backend lint generate_tests \

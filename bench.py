@@ -115,13 +115,18 @@ def run_workload() -> dict:
     best = times[len(times) // 2]
 
     sigs_per_sec = (n * k) / best
-    return dict(
+    result = dict(
         value=sigs_per_sec,
         vs_baseline=sigs_per_sec / TARGET_PER_CHIP,
         platform=platform,
         n=n,
         k=k,
     )
+    if os.environ.get("CONSENSUS_SPECS_TPU_PROFILE") == "1":
+        from consensus_specs_tpu.ops import profiling
+
+        result["profile"] = profiling.summary()
+    return result
 
 
 def _run_child_attempt(timeout: float):

@@ -1,0 +1,20 @@
+"""`random` test-vector generator: seeded randomized-transition scenarios
+(reference: tests/generators/random; scenario machinery
+test/helpers/random.py here replaces the reference's code-generated
+test_random.py files)."""
+import sys
+
+from ..gen_from_tests import run_state_test_generators
+
+_T = "consensus_specs_tpu.test"
+
+MODS = {"random": f"{_T}.phase0.random.test_random"}
+ALL_MODS = {fork: MODS for fork in ("phase0", "altair", "merge")}
+
+
+def main(args=None) -> int:
+    return run_state_test_generators("random", ALL_MODS, args=args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -13,6 +13,18 @@ import jax
 
 jax.config.update("jax_enable_x64", True)
 
+# Mirror JAX_PLATFORMS into the live config: the axon sitecustomize hook
+# wraps get_backend and initializes EVERY registered platform on the first
+# device op unless jax_platforms is pinned in config — so a plain
+# `JAX_PLATFORMS=cpu python script.py` would still try to bring up the
+# (possibly hanging) TPU tunnel. See TPU_NOTES.md.
+_platforms = os.environ.get("JAX_PLATFORMS")
+if _platforms:
+    try:
+        jax.config.update("jax_platforms", _platforms)
+    except Exception:
+        pass
+
 # Persistent XLA compilation cache: VM step programs are compiled once per
 # shape bucket per machine, then loaded from disk (~ms) on later runs.
 _cache_dir = os.environ.get(

@@ -1,0 +1,87 @@
+"""Device-pipeline observability (SURVEY §5 aux: the reference's only
+profiling is slow-case prints, gen_runner.py:26; a TPU compute plane needs
+per-kernel timing and an XLA trace hook).
+
+- ``record(...)`` is called by vm.execute around every device program run;
+  stats accumulate per (program kind, batch shape) in-process.
+- ``summary()``/``report()`` expose them; bench.py attaches the summary to
+  its JSON line when CONSENSUS_SPECS_TPU_PROFILE=1.
+- ``trace(path)`` wraps a block in jax.profiler's trace for TensorBoard /
+  xprof when deeper inspection is wanted (no-op if the profiler is
+  unavailable on the platform).
+"""
+import contextlib
+import os
+import time
+from collections import defaultdict
+from typing import Dict
+
+ENABLED = os.environ.get("CONSENSUS_SPECS_TPU_PROFILE") == "1"
+
+_stats: Dict[str, Dict[str, float]] = defaultdict(
+    lambda: {"calls": 0, "total_s": 0.0, "max_s": 0.0}
+)
+
+
+def record(label: str, seconds: float) -> None:
+    s = _stats[label]
+    s["calls"] += 1
+    s["total_s"] += seconds
+    s["max_s"] = max(s["max_s"], seconds)
+
+
+@contextlib.contextmanager
+def timed(label: str):
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        record(label, time.perf_counter() - t0)
+
+
+def summary() -> Dict[str, Dict[str, float]]:
+    return {
+        k: {
+            "calls": int(v["calls"]),
+            "total_s": round(v["total_s"], 4),
+            "mean_s": round(v["total_s"] / max(1, v["calls"]), 4),
+            "max_s": round(v["max_s"], 4),
+        }
+        for k, v in sorted(_stats.items())
+    }
+
+
+def reset() -> None:
+    _stats.clear()
+
+
+def report() -> str:
+    lines = ["device-pipeline timing:"]
+    for label, s in summary().items():
+        lines.append(
+            f"  {label}: {s['calls']} calls, mean {s['mean_s']*1e3:.1f}ms, "
+            f"max {s['max_s']*1e3:.1f}ms, total {s['total_s']:.2f}s"
+        )
+    return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """jax.profiler trace around a block (view with TensorBoard/xprof)."""
+    try:
+        import jax
+
+        jax.profiler.start_trace(log_dir)
+        started = True
+    except Exception:
+        started = False
+    try:
+        yield
+    finally:
+        if started:
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+            except Exception:
+                pass

@@ -387,9 +387,25 @@ def execute(program: Program, inputs: Dict[str, np.ndarray], batch_shape=(),
     of shape batch_shape + (NUM_LIMBS,). Returns named outputs (loose,
     bounded < 2^382). With ``mesh``, the leading batch axis is sharded over
     the mesh's first axis (batch_shape[0] must divide by its size)."""
+    from . import profiling
+
     regs = program.init_regs(tuple(batch_shape))
     regs = program.load_inputs(regs, inputs)
     instr = tuple(jnp.asarray(x) for x in program.instr)
+    label = (
+        f"vm[steps={program.n_steps},regs={program.n_regs},"
+        f"batch={tuple(batch_shape)},sharded={mesh is not None}]"
+    )
+    with profiling.timed(label):
+        out = _execute_device(regs, instr, mesh)
+    out = np.asarray(out)
+    return {
+        name: out[..., int(reg), :]
+        for name, reg in zip(program.output_names, program.output_regs)
+    }
+
+
+def _execute_device(regs, instr, mesh):
     if mesh is None:
         out = _vm_run(jnp.asarray(regs), instr)
     else:
@@ -403,8 +419,4 @@ def execute(program: Program, inputs: Dict[str, np.ndarray], batch_shape=(),
             jax.device_put(x, NamedSharding(mesh, P())) for x in instr
         )
         out = _vm_run_for_mesh(mesh)(regs_d, instr_d)
-    out = np.asarray(out)
-    return {
-        name: out[..., int(reg), :]
-        for name, reg in zip(program.output_names, program.output_regs)
-    }
+    return out
