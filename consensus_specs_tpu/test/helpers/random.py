@@ -59,8 +59,45 @@ def randomize_participation(spec, state, rng):
 
 
 def random_block(spec, state, rng, exited: set):
-    """A valid-by-construction block carrying a random operation mix."""
+    """A valid-by-construction block carrying a random operation mix
+    (attestations, exits, proposer/attester slashings, deposit top-ups —
+    the multi-operation composition the reference's
+    helpers/multi_operations.py provides)."""
+    from .attester_slashings import get_valid_attester_slashing
+    from .deposits import prepare_state_and_deposit
+    from .proposer_slashings import get_valid_proposer_slashing
+
+    # deposits FIRST: prepare_state_and_deposit rewrites state.eth1_data,
+    # which feeds the state root the block's parent header snapshots
+    pending_deposit = None
+    if rng.random() < 0.15:
+        index = rng.randrange(len(state.validators))
+        amount = spec.Gwei(rng.randrange(1, int(spec.MAX_EFFECTIVE_BALANCE) // 4))
+        pending_deposit = prepare_state_and_deposit(
+            spec, state, index, amount, signed=True
+        )
+
     block = build_empty_block_for_next_slot(spec, state)
+    if pending_deposit is not None:
+        block.body.deposits.append(pending_deposit)
+        block.body.eth1_data.deposit_count = state.eth1_deposit_index + 1
+    # occasional proposer slashing of a not-yet-slashed validator
+    if rng.random() < 0.15:
+        try:
+            ps = get_valid_proposer_slashing(spec, state, signed_1=True, signed_2=True)
+            if not state.validators[ps.signed_header_1.message.proposer_index].slashed:
+                block.body.proposer_slashings.append(ps)
+        except Exception:
+            pass  # no eligible proposer in this state shape
+    # occasional attester slashing
+    if rng.random() < 0.1:
+        try:
+            aslash = get_valid_attester_slashing(spec, state, signed_1=True, signed_2=True)
+            index = aslash.attestation_1.attesting_indices[0]
+            if not state.validators[index].slashed:
+                block.body.attester_slashings.append(aslash)
+        except Exception:
+            pass
     # random attestations for an includable slot
     if state.slot >= spec.MIN_ATTESTATION_INCLUSION_DELAY and rng.random() < 0.8:
         slot_to_attest = state.slot - spec.MIN_ATTESTATION_INCLUSION_DELAY + 1
@@ -99,10 +136,17 @@ def run_random_scenario(spec, state, rng, slots):
     exited: set = set()
     signed_blocks = []
     for _ in range(slots):
-        if rng.random() < 0.15:
-            # skipped slot
+        if rng.random() < 0.15 or _next_proposer_slashed(spec, state):
+            # skipped slot (deliberate, or the due proposer was slashed by an
+            # earlier block — a live chain skips that slot too)
             spec.process_slots(state, state.slot + 1)
             continue
         block = random_block(spec, state, rng, exited)
         signed_blocks.append(state_transition_and_sign_block(spec, state, block))
     return signed_blocks
+
+
+def _next_proposer_slashed(spec, state) -> bool:
+    tmp = state.copy()
+    spec.process_slots(tmp, tmp.slot + 1)
+    return bool(tmp.validators[spec.get_beacon_proposer_index(tmp)].slashed)
