@@ -13,6 +13,22 @@ class MetaData(Container):
     syncnets: Bitvector[SYNC_COMMITTEE_SUBNET_COUNT]
 
 
+def compute_gossip_message_id(message_data: bytes, valid_snappy_decompressed: bytes = None,
+                              topic: bytes = b'') -> bytes:
+    """Altair message-id binds the TOPIC alongside the payload
+    (altair/p2p-interface.md:77-89): SHA256(domain + uint64(len(topic)) +
+    topic + payload)[:20]. Phase0-digest topics keep the phase0 procedure."""
+    if valid_snappy_decompressed is not None:
+        return hash(
+            MESSAGE_DOMAIN_VALID_SNAPPY + uint_to_bytes(uint64(len(topic)))
+            + topic + valid_snappy_decompressed
+        )[:20]
+    return hash(
+        MESSAGE_DOMAIN_INVALID_SNAPPY + uint_to_bytes(uint64(len(topic)))
+        + topic + message_data
+    )[:20]
+
+
 def get_sync_subcommittee_pubkeys(state: BeaconState, subcommittee_index: uint64) -> Sequence[BLSPubkey]:
     # (altair/p2p-interface.md:124-138 — gossip-validation convenience)
     # Committees assigned to `slot` sign for `slot - 1`

@@ -7,14 +7,21 @@ from ...context import spec_state_test, with_all_phases
 @with_all_phases
 @spec_state_test
 def test_gossip_message_id_domains(spec, state):
+    from ...helpers.forks import is_post_altair
+
     payload = b"some gossip payload"
     valid_id = spec.compute_gossip_message_id(payload, payload)
     invalid_id = spec.compute_gossip_message_id(payload, None)
     assert len(valid_id) == 20 and len(invalid_id) == 20
     # domain separation: the same bytes id differently by snappy validity
     assert valid_id != invalid_id
-    assert valid_id == spec.hash(spec.MESSAGE_DOMAIN_VALID_SNAPPY + payload)[:20]
-    assert invalid_id == spec.hash(spec.MESSAGE_DOMAIN_INVALID_SNAPPY + payload)[:20]
+    if is_post_altair(spec):
+        # altair+ prepends the (empty here) topic length + bytes
+        prefix = spec.uint_to_bytes(spec.uint64(0))
+    else:
+        prefix = b""
+    assert valid_id == spec.hash(spec.MESSAGE_DOMAIN_VALID_SNAPPY + prefix + payload)[:20]
+    assert invalid_id == spec.hash(spec.MESSAGE_DOMAIN_INVALID_SNAPPY + prefix + payload)[:20]
 
 
 @with_all_phases
@@ -67,3 +74,22 @@ def test_status_message_roundtrip(spec, state):
         head_slot=300,
     )
     assert spec.Status.decode_bytes(status.encode_bytes()) == status
+
+
+@with_all_phases
+@spec_state_test
+def test_altair_message_id_binds_topic(spec, state):
+    from ...helpers.forks import is_post_altair
+
+    if not is_post_altair(spec):
+        return
+    payload = b"payload bytes"
+    a = spec.compute_gossip_message_id(payload, payload, topic=b"/eth2/x/beacon_block/ssz_snappy")
+    b = spec.compute_gossip_message_id(payload, payload, topic=b"/eth2/x/other_topic/ssz_snappy")
+    assert a != b  # same payload, different topic, different id
+    want = spec.hash(
+        spec.MESSAGE_DOMAIN_VALID_SNAPPY
+        + spec.uint_to_bytes(spec.uint64(len(b"/eth2/x/beacon_block/ssz_snappy")))
+        + b"/eth2/x/beacon_block/ssz_snappy" + payload
+    )[:20]
+    assert a == want
