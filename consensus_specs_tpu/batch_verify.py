@@ -196,3 +196,17 @@ def replay_blocks_batched(spec, state, signed_blocks: Sequence) -> np.ndarray:
         for signed_block in signed_blocks:
             spec.state_transition(state, signed_block)
     return col.flush()
+
+
+def feed_attestations_batched(spec, store, attestations: Sequence) -> np.ndarray:
+    """Feed wire attestations to fork-choice ``on_attestation`` with their
+    FastAggregateVerify checks collected, then batch-verified — the
+    fork-choice side of the hot loop (reference
+    specs/phase0/fork-choice.md:393-410). Store mutations happen
+    optimistically during collection; a False in the result means the span
+    must be re-fed per-call against a fresh store (the reference's
+    always-sequential path)."""
+    with SignatureCollector(spec) as col:
+        for attestation in attestations:
+            spec.on_attestation(store, attestation)
+    return col.flush()

@@ -121,3 +121,43 @@ def test_epoch_replay_detects_corruption():
         for sb in list(signed_blocks[:-1]) + [resigned]:
             spec.state_transition(state2, sb)
     assert np.array_equal(ok, col2.flush_oracle())
+
+
+@pytest.mark.slow
+def test_fork_choice_attestations_batched():
+    """on_attestation feeding with collected checks matches the sequential
+    model: same latest_messages, all checks verify."""
+    from consensus_specs_tpu.batch_verify import feed_attestations_batched
+    from consensus_specs_tpu.test.context import build_spec_module
+    from consensus_specs_tpu.test.helpers.attestations import get_valid_attestation
+    from consensus_specs_tpu.test.helpers.block import build_empty_block_for_next_slot
+    from consensus_specs_tpu.test.helpers.fork_choice import (
+        get_genesis_forkchoice_store, slot_time,
+    )
+    from consensus_specs_tpu.test.helpers.genesis import create_genesis_state
+    from consensus_specs_tpu.test.helpers.state import state_transition_and_sign_block
+
+    spec = build_spec_module("phase0", "minimal")
+    bls.bls_active = True
+    state = create_genesis_state(
+        spec, [spec.MAX_EFFECTIVE_BALANCE] * 64, spec.MAX_EFFECTIVE_BALANCE
+    )
+    store = get_genesis_forkchoice_store(spec, state)
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    spec.on_tick(store, slot_time(spec, store, block.slot + 1))
+    spec.on_block(store, signed_block)
+
+    attestations = [
+        get_valid_attestation(spec, state, slot=block.slot, index=i, signed=True)
+        for i in range(int(spec.get_committee_count_per_slot(
+            state, spec.get_current_epoch(state)
+        )))
+    ]
+    ok = feed_attestations_batched(spec, store, attestations)
+    assert len(ok) == len(attestations) and ok.all()
+    # every attester's LMD vote landed, exactly as sequential feeding would
+    voters = set()
+    for a in attestations:
+        voters |= set(spec.get_attesting_indices(state, a.data, a.aggregation_bits))
+    assert set(store.latest_messages) == voters
