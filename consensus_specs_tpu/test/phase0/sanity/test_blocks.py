@@ -412,3 +412,53 @@ def test_eth1_data_votes_consensus(spec, state):
     assert state.slot % voting_period_slots == 0
     assert len(state.eth1_data_votes) == 1
     assert state.eth1_data_votes[0].block_hash == c
+
+
+@with_all_phases
+@spec_state_test
+def test_full_operation_mix_in_one_block(spec, state):
+    """One block carrying an attestation, a proposer slashing, an attester
+    slashing, a deposit top-up, and a voluntary exit simultaneously — the
+    operation kinds must compose (process_operations order,
+    reference specs/phase0/beacon-chain.md:1742-1756)."""
+    # age the chain so exits are permitted and attestations exist
+    state.slot += spec.config.SHARD_COMMITTEE_PERIOD * spec.SLOTS_PER_EPOCH
+    next_epoch(spec, state)
+
+    deposit = prepare_state_and_deposit(
+        spec, state, validator_index=1, amount=spec.MAX_EFFECTIVE_BALANCE // 4,
+        signed=True,
+    )
+
+    block = build_empty_block_for_next_slot(spec, state)
+    attestation = get_valid_attestation(spec, state, slot=state.slot, signed=True)
+    proposer_slashing = get_valid_proposer_slashing(
+        spec, state, signed_1=True, signed_2=True
+    )
+    ps_index = proposer_slashing.signed_header_1.message.proposer_index
+    attester_slashing = get_valid_attester_slashing(
+        spec, state, signed_1=True, signed_2=True
+    )
+    as_index = attester_slashing.attestation_1.attesting_indices[0]
+    # pick an exit candidate not colliding with the slashed validators
+    exit_index = next(
+        i for i in spec.get_active_validator_indices(state, spec.get_current_epoch(state))
+        if i not in (ps_index, as_index, 1)
+    )
+    signed_exits = prepare_signed_exits(spec, state, [exit_index])
+
+    block.body.attestations.append(attestation)
+    block.body.proposer_slashings.append(proposer_slashing)
+    block.body.attester_slashings.append(attester_slashing)
+    block.body.deposits.append(deposit)
+    block.body.voluntary_exits = signed_exits
+    block.body.eth1_data.deposit_count = state.eth1_deposit_index + 1
+
+    yield 'pre', state
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield 'blocks', [signed_block]
+    yield 'post', state
+
+    assert state.validators[ps_index].slashed
+    assert state.validators[as_index].slashed
+    assert state.validators[exit_index].exit_epoch < spec.FAR_FUTURE_EPOCH
