@@ -20,6 +20,8 @@ BYTES_PER_CUSTODY_ATOM = 32
 CUSTODY_PRIME = 2**256 - 189
 CUSTODY_SECRETS = 3
 CUSTODY_PROBABILITY_EXPONENT = 10
+EPOCHS_PER_CUSTODY_PERIOD = 2**14
+CUSTODY_PERIOD_TO_RANDAO_PADDING = 2**11
 
 
 def legendre_bit(a: int, q: int) -> int:
@@ -73,6 +75,25 @@ def universal_hash_function(data_chunks: Sequence[bytes], secrets: Sequence[int]
             * int.from_bytes(atom, "little")
         ) % CUSTODY_PRIME
     return (acc + pow(secrets[n % CUSTODY_SECRETS], n, CUSTODY_PRIME)) % CUSTODY_PRIME
+
+
+def get_randao_epoch_for_custody_period(period: int, validator_index: int) -> int:
+    """Epoch whose randao reveal keys a validator's custody period — each
+    validator's period boundary is offset by its index, staggering reveals
+    (custody_game/beacon-chain.md:336-341)."""
+    next_period_start = (
+        (period + 1) * EPOCHS_PER_CUSTODY_PERIOD
+        - validator_index % EPOCHS_PER_CUSTODY_PERIOD
+    )
+    return next_period_start + CUSTODY_PERIOD_TO_RANDAO_PADDING
+
+
+def get_custody_period_for_validator(validator_index: int, epoch: int) -> int:
+    """Reveal period covering ``epoch`` for ``validator_index``
+    (custody_game/beacon-chain.md:343-350)."""
+    return (
+        epoch + validator_index % EPOCHS_PER_CUSTODY_PERIOD
+    ) // EPOCHS_PER_CUSTODY_PERIOD
 
 
 def compute_custody_bit(key: bytes, data: bytes) -> int:

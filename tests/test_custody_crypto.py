@@ -55,3 +55,24 @@ def test_universal_hash_function_linearity_breaks():
     b = [a[1], a[0]]
     assert custody.universal_hash_function(a, secrets) != \
         custody.universal_hash_function(b, secrets)
+
+
+def test_custody_periods_are_staggered_and_consistent():
+    E = custody.EPOCHS_PER_CUSTODY_PERIOD
+    for validator_index in (0, 1, 7, E - 1, E + 5):
+        for epoch in (0, 1, E - 1, E, 3 * E + 17):
+            period = custody.get_custody_period_for_validator(validator_index, epoch)
+            # the keying randao epoch lands after the period ends (padding)
+            randao_epoch = custody.get_randao_epoch_for_custody_period(
+                period, validator_index
+            )
+            period_end = (period + 1) * E - validator_index % E
+            assert randao_epoch == period_end + custody.CUSTODY_PERIOD_TO_RANDAO_PADDING
+            # the epoch really falls inside the period's staggered window
+            start = period * E - validator_index % E
+            assert start <= epoch < start + E
+    # two validators with different offsets get different boundaries
+    assert (
+        custody.get_custody_period_for_validator(0, E - 1)
+        != custody.get_custody_period_for_validator(1, E - 1)
+    )
