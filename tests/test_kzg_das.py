@@ -148,3 +148,44 @@ def test_das_sampling_end_to_end():
             kept, sample_count, points_per_sample
         )
         assert recovered == list(extended)
+
+
+def test_sharding_fee_market_and_blob_check():
+    """Sample-price updates move toward target and stay bounded; the
+    shard-blob acceptance combines commitment + degree proof
+    (utils/sharding.py; sharding/beacon-chain.md:433-457, 700-721)."""
+    from consensus_specs_tpu.utils import sharding
+
+    price = 1000
+    # oversubscribed blobs push the price up, capped
+    up = sharding.compute_updated_sample_price(
+        price, sharding.MAX_SAMPLES_PER_BLOB, active_shards=64
+    )
+    assert up > price
+    assert sharding.compute_updated_sample_price(
+        sharding.MAX_SAMPLE_PRICE, sharding.MAX_SAMPLES_PER_BLOB, 64
+    ) == sharding.MAX_SAMPLE_PRICE
+    # undersubscribed pulls it down, floored
+    down = sharding.compute_updated_sample_price(price, 0, active_shards=64)
+    assert down < price
+    assert sharding.compute_updated_sample_price(
+        sharding.MIN_SAMPLE_PRICE, 0, 64
+    ) <= sharding.MIN_SAMPLE_PRICE
+    # exactly on target with minimal delta: stable within the min delta of 1
+    assert abs(sharding.compute_updated_sample_price(
+        price, sharding.TARGET_SAMPLES_PER_BLOB, 64
+    ) - price) <= 1
+
+    # committee lookahead: one period behind the period boundary
+    P_ = 64
+    assert sharding.compute_committee_source_epoch(P_ * 3 + 5, P_) == P_ * 2
+    assert sharding.compute_committee_source_epoch(P_ - 1, P_) == 0
+
+    # blob acceptance
+    data = _random_data(N)
+    poly = kzg.inverse_fft(kzg.reverse_bit_order_list(data))
+    commitment = kzg.commit_to_data(SETUP, data)
+    dproof = kzg.degree_proof(SETUP, poly, N)
+    assert sharding.verify_shard_blob_commitment(SETUP, commitment, dproof, data)
+    other = kzg.commit_to_poly(SETUP, _random_data(N))
+    assert not sharding.verify_shard_blob_commitment(SETUP, other, dproof, data)
