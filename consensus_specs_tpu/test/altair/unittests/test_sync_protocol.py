@@ -176,3 +176,60 @@ def test_validate_light_client_update_bad_finality_proof_rejected(spec, state):
     expect_assertion_error(lambda: spec.validate_light_client_update(
         snapshot, update, state.genesis_validators_root
     ))
+
+
+@with_phases([ALTAIR])
+@with_presets([MINIMAL], reason="pure-python sync committee signing")
+@spec_state_test
+def test_process_light_client_update_timeout_forces_best(spec, state):
+    """After a full sync-committee period without finality, the best queued
+    update (most participation) is force-applied
+    (sync-protocol.md:186-195)."""
+    transition_to(spec, state, state.slot + 2)
+    snapshot = _snapshot_for(spec, state)
+    store = spec.LightClientStore(snapshot=snapshot, valid_updates=set())
+    committee_indices = get_committee_indices(spec, state)
+    nsc_branch, fin_branch = _empty_branches(spec)
+    size = int(spec.SYNC_COMMITTEE_SIZE)
+
+    def make_update(n_participants, slot):
+        header = spec.BeaconBlockHeader(
+            slot=slot, state_root=spec.hash_tree_root(state)
+        )
+        bits = [i < n_participants for i in range(size)]
+        participants = [committee_indices[i] for i in range(n_participants)]
+        return spec.LightClientUpdate(
+            header=header,
+            next_sync_committee=state.next_sync_committee,
+            next_sync_committee_branch=nsc_branch,
+            finality_header=spec.BeaconBlockHeader(),
+            finality_branch=fin_branch,
+            sync_committee_bits=bits,
+            sync_committee_signature=_sign_header(
+                spec, state, header, participants
+            ),
+        )
+
+    # two queued updates without finality proofs; neither applies yet
+    weak = make_update(size // 3, state.slot)
+    strong = make_update(size // 2, state.slot + 1)  # < 2/3: no quorum apply
+    spec.process_light_client_update(
+        store, weak, state.slot, state.genesis_validators_root
+    )
+    spec.process_light_client_update(
+        store, strong, state.slot, state.genesis_validators_root
+    )
+    assert len(store.valid_updates) == 2
+    assert store.snapshot.header == spec.BeaconBlockHeader()
+
+    # past the update timeout, feeding any update force-applies the BEST one
+    late_slot = (
+        int(state.slot)
+        + int(spec.SLOTS_PER_EPOCH * spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD) + 1
+    )
+    another = make_update(size // 3, state.slot)
+    spec.process_light_client_update(
+        store, another, spec.Slot(late_slot), state.genesis_validators_root
+    )
+    assert store.snapshot.header == strong.header  # most participation won
+    assert len(store.valid_updates) == 0
