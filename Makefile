@@ -5,7 +5,7 @@ VECTORS_DIR ?= ../consensus-spec-tests/tests
 PYTEST = JAX_PLATFORMS=cpu python -m pytest
 
 GENERATORS = operations sanity epoch_processing rewards finality forks transition random \
-             fork_choice ssz_static ssz_generic shuffling bls genesis
+             fork_choice ssz_static ssz_generic shuffling bls genesis merkle
 
 .PHONY: test citest test_tpu_backend lint generate_tests \
         detect_generator_incomplete bench multichip clean_vectors

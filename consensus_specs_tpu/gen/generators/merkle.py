@@ -1,0 +1,80 @@
+"""`merkle` test-vector generator: single Merkle proofs over BeaconState
+(reference: the altair light-client merkle single_proof suite; format
+tests/formats/merkle/README.md — leaf, proof branch, generalized index)."""
+import sys
+from random import Random
+
+from ...builder import IMPLEMENTED_FORKS, build_spec_module
+from ...utils.ssz.gindex import get_generalized_index
+from ...utils.ssz.proofs import build_proof
+from ..gen_runner import run_generator
+from ..gen_typing import TestCase, TestProvider
+
+PATHS = [
+    ("finalized_checkpoint_root", ("finalized_checkpoint", "root")),
+    ("current_justified_checkpoint", ("current_justified_checkpoint",)),
+    ("fork", ("fork",)),
+    ("next_sync_committee", ("next_sync_committee",)),  # altair+
+]
+
+
+def _case(spec, state, name, path):
+    def case_fn():
+        try:
+            gindex = get_generalized_index(spec.BeaconState, *path)
+        except (KeyError, ValueError):
+            return None  # field absent in this fork
+        leaf = state
+        for p in path:
+            leaf = getattr(leaf, p)
+        branch = build_proof(state, *path)
+        assert spec.is_valid_merkle_branch(
+            leaf=leaf.hash_tree_root(),
+            branch=branch,
+            depth=spec.floorlog2(gindex),
+            index=spec.get_subtree_index(gindex) if hasattr(spec, "get_subtree_index")
+            else int(gindex) % (1 << (int(gindex).bit_length() - 1)),
+            root=state.hash_tree_root(),
+        )
+        return [
+            ("state", "ssz", state.encode_bytes()),
+            ("proof", "data", {
+                "leaf": "0x" + leaf.hash_tree_root().hex(),
+                "leaf_index": int(gindex),
+                "branch": ["0x" + b.hex() for b in branch],
+            }),
+        ]
+
+    return case_fn
+
+
+def make_cases():
+    rng = Random(1331)
+    for preset in ("minimal",):
+        for fork in IMPLEMENTED_FORKS:
+            spec = build_spec_module(fork, preset)
+            state = spec.BeaconState()
+            state.slot = 77
+            state.finalized_checkpoint.epoch = 3
+            state.finalized_checkpoint.root = bytes(rng.getrandbits(8) for _ in range(32))
+            for name, path in PATHS:
+                if path[0] not in spec.BeaconState.fields():
+                    continue
+                yield TestCase(
+                    fork_name=fork,
+                    preset_name=preset,
+                    runner_name="merkle",
+                    handler_name="single_proof",
+                    suite_name="pyspec_tests",
+                    case_name=name,
+                    case_fn=_case(spec, state, name, path),
+                )
+
+
+def main(args=None) -> int:
+    provider = TestProvider(prepare=lambda: None, make_cases=make_cases)
+    return run_generator("merkle", [provider], args=args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

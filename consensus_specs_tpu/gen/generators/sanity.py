@@ -7,12 +7,24 @@ from ..gen_from_tests import run_state_test_generators
 
 _T = "consensus_specs_tpu.test"
 
-MODS = {
+from ..gen_from_tests import combine_mods  # noqa: E402
+
+PHASE0_MODS = {
     "blocks": f"{_T}.phase0.sanity.test_blocks",
     "slots": f"{_T}.phase0.sanity.test_slots",
 }
+ALTAIR_MODS = combine_mods(PHASE0_MODS, {
+    "sync_blocks": f"{_T}.altair.sanity.test_blocks",
+})
+MERGE_MODS = combine_mods(ALTAIR_MODS, {
+    "payload_blocks": f"{_T}.merge.sanity.test_blocks",
+})
 
-ALL_MODS = {fork: MODS for fork in ("phase0", "altair", "merge")}
+ALL_MODS = {
+    "phase0": PHASE0_MODS,
+    "altair": ALTAIR_MODS,
+    "merge": MERGE_MODS,
+}
 
 
 def main(args=None) -> int:

@@ -3,6 +3,7 @@ container of every built spec, with serialized bytes + hash_tree_root
 (reference: tests/generators/ssz_static/main.py:21-36; format
 tests/formats/ssz_static/README.md)."""
 import sys
+import zlib
 from random import Random
 
 from ...builder import IMPLEMENTED_FORKS, build_spec_module
@@ -55,9 +56,16 @@ def make_cases():
                     RandomizationMode.mode_random,
                     RandomizationMode.mode_zero,
                     RandomizationMode.mode_max,
+                    RandomizationMode.mode_nil_count,
+                    RandomizationMode.mode_one_count,
+                    RandomizationMode.mode_max_count,
                 ):
                     for count in range(2 if mode == RandomizationMode.mode_random else 1):
-                        seed = hash((preset, fork, name, mode.value, count)) & 0xFFFFFFFF
+                        # stable across processes (builtin hash is salted,
+                        # which would re-randomize vectors every run)
+                        seed = zlib.crc32(
+                            f"{preset}/{fork}/{name}/{mode.value}/{count}".encode()
+                        )
                         yield TestCase(
                             fork_name=fork,
                             preset_name=preset,
