@@ -49,20 +49,25 @@ def _module_cases(runner_name: str, mod_path: str, fork: str, preset: str):
 
 
 def run_state_test_generators(runner_name: str,
-                              all_mods: Dict[str, Dict[str, str]],
+                              all_mods: Dict[str, Dict[str, object]],
                               args=None) -> int:
-    """``all_mods``: {fork: {handler: module path}}; runs the generator CLI
-    over presets x forks x modules (reference gen.py:96-111)."""
+    """``all_mods``: {fork: {handler: module path or list of paths}} — a
+    list means several fork-specific test modules emit under ONE official
+    handler name (reference gen.py:96-132; combine_mods merges same-key
+    entries into lists for exactly this)."""
     from .gen_runner import run_generator
 
     def make_cases():
         for preset in ("minimal", "mainnet"):
             for fork, mods in all_mods.items():
-                for handler, mod_path in mods.items():
-                    src = import_module(mod_path)
-                    yield from generate_from_tests(
-                        runner_name, handler, src, fork, preset
-                    )
+                for handler, mod_paths in mods.items():
+                    if isinstance(mod_paths, str):
+                        mod_paths = [mod_paths]
+                    for mod_path in mod_paths:
+                        src = import_module(mod_path)
+                        yield from generate_from_tests(
+                            runner_name, handler, src, fork, preset
+                        )
 
     def prepare():
         # pin the pure-python oracle backend (the reference prepares milagro,
@@ -76,9 +81,14 @@ def run_state_test_generators(runner_name: str,
     return run_generator(runner_name, [provider], args=args)
 
 
-def combine_mods(dict_1: Dict[str, str], dict_2: Dict[str, str]) -> Dict[str, str]:
-    """Merge handler->module maps; later entries win
+def combine_mods(dict_1, dict_2):
+    """Merge handler->module(s) maps; entries sharing a handler COMBINE into
+    a list so all their tests emit under that handler
     (reference gen.py:114-132)."""
-    out = dict(dict_1)
-    out.update(dict_2)
+    def as_list(v):
+        return list(v) if isinstance(v, (list, tuple)) else [v]
+
+    out = {k: as_list(v) for k, v in dict_1.items()}
+    for k, v in dict_2.items():
+        out[k] = out.get(k, []) + as_list(v)
     return out

@@ -18,12 +18,9 @@ PATHS = [
 ]
 
 
-def _case(spec, state, name, path):
+def _case(spec, state, path):
     def case_fn():
-        try:
-            gindex = get_generalized_index(spec.BeaconState, *path)
-        except (KeyError, ValueError):
-            return None  # field absent in this fork
+        gindex = get_generalized_index(spec.BeaconState, *path)
         leaf = state
         for p in path:
             leaf = getattr(leaf, p)
@@ -67,7 +64,7 @@ def make_cases():
                     handler_name="single_proof",
                     suite_name="pyspec_tests",
                     case_name=name,
-                    case_fn=_case(spec, state, name, path),
+                    case_fn=_case(spec, state, path),
                 )
 
 
