@@ -13,6 +13,7 @@ PHASE0_MODS = {
     "block_header": f"{_T}.phase0.block_processing.test_process_block_header",
     "deposit": f"{_T}.phase0.block_processing.test_process_deposit",
     "proposer_slashing": f"{_T}.phase0.block_processing.test_process_proposer_slashing",
+    "randao": f"{_T}.phase0.block_processing.test_process_randao",
     "voluntary_exit": f"{_T}.phase0.block_processing.test_process_voluntary_exit",
 }
 ALTAIR_MODS = combine_mods(PHASE0_MODS, {

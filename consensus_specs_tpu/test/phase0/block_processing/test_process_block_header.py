@@ -78,3 +78,21 @@ def test_proposer_slashed(spec, state):
     block = build_empty_block_for_next_slot(spec, state)
 
     yield from run_block_header_processing(spec, state, block, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_duplicate_slot_header(spec, state):
+    """A second block at the latest header's slot must be rejected
+    (`block.slot > state.latest_block_header.slot`)."""
+    block = build_empty_block_for_next_slot(spec, state)
+    spec.process_slots(state, block.slot)
+    spec.process_block_header(state, block)
+    # same slot again, different content
+    dup = build_empty_block_for_next_slot(spec, state.copy())
+    dup.slot = block.slot
+    dup.body.graffiti = b'\x09' * 32
+    yield 'pre', state
+    yield 'block', dup
+    expect_assertion_error(lambda: spec.process_block_header(state, dup))
+    yield 'post', None

@@ -1,0 +1,1 @@
+from consensus_specs_tpu.test.phase0.block_processing.test_process_randao import *  # noqa: F401,F403
