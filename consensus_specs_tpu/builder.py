@@ -24,10 +24,12 @@ from .config.config_util import load_defaults, load_preset_for_fork
 
 SPEC_SRC_DIR = Path(__file__).resolve().parent / "specsrc"
 
-FORK_ORDER = ["phase0", "altair", "merge"]
+FORK_ORDER = ["phase0", "altair", "merge", "sharding", "custody_game"]
 
-# forks with authored spec sources; extended as forks land
-IMPLEMENTED_FORKS = ["phase0", "altair", "merge"]
+# forks with authored spec sources; extended as forks land.
+# sharding + custody_game are draft forks the reference does NOT compile
+# (reference test/context.py:398-399) — executable here, beyond the reference.
+IMPLEMENTED_FORKS = ["phase0", "altair", "merge", "sharding", "custody_game"]
 
 SOURCES = {
     "phase0": [
@@ -49,6 +51,13 @@ SOURCES = {
         "beacon_chain.py",
         "fork_choice.py",
         "fork.py",
+        "validator.py",
+    ],
+    "sharding": [
+        "beacon_chain.py",
+    ],
+    "custody_game": [
+        "beacon_chain.py",
         "validator.py",
     ],
 }

@@ -57,7 +57,7 @@ _defaults_cache: Dict[str, Dict[str, Any]] = {}
 
 # fork lineage: preset files are merged in this order up to the built fork
 # (reference: setup.py per-fork md-doc lists, :843-872)
-PRESET_FORK_FILES = ["phase0", "altair", "merge", "custody_game", "sharding"]
+PRESET_FORK_FILES = ["phase0", "altair", "merge", "sharding", "custody_game"]
 
 
 def load_preset_for_fork(preset_name: str, fork: str) -> Dict[str, Any]:

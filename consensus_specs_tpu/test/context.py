@@ -24,9 +24,15 @@ from ..utils import bls
 PHASE0 = "phase0"
 ALTAIR = "altair"
 MERGE = "merge"
+# Experimental draft forks (reference helpers/constants.py:12-14) — excluded
+# from ALL_PHASES so `with_all_phases` never picks them up, but runnable via
+# an explicit `with_phases([SHARDING])` (executable here, unlike reference)
+SHARDING = "sharding"
+CUSTODY_GAME = "custody_game"
 MINIMAL = "minimal"
 MAINNET = "mainnet"
 ALL_PHASES = (PHASE0, ALTAIR, MERGE)
+EXPERIMENTAL_PHASES = (SHARDING, CUSTODY_GAME)
 ALL_PRESETS = (MINIMAL, MAINNET)
 
 DEFAULT_TEST_PRESET = MINIMAL
@@ -258,6 +264,30 @@ def never_bls(fn):
     return entry
 
 
+def disable_process_reveal_deadlines(fn):
+    """Monkeypatch the custody fork's process_reveal_deadlines to a no-op so
+    long multi-period scenarios don't mass-slash unrevealed validators
+    (reference context.py:316-331)."""
+
+    @_wraps(fn)
+    def entry(*args, spec, **kw):
+        has_pass = hasattr(spec, "process_reveal_deadlines")
+        old = spec.process_reveal_deadlines if has_pass else None
+        if has_pass:
+            spec.process_reveal_deadlines = lambda state: None
+        try:
+            kw["spec"] = spec
+            res = _invoke(fn, kw)
+            if res is not None:
+                yield from res
+        finally:
+            if has_pass:
+                spec.process_reveal_deadlines = old
+
+    entry.reveal_deadlines_setting = 1
+    return entry
+
+
 def spec_test(fn):
     return vector_test()(bls_switch(fn))
 
@@ -321,7 +351,10 @@ def with_config_overrides(config_overrides):
 def _phases_to_run(phases):
     from ..builder import IMPLEMENTED_FORKS
 
-    run = [p for p in phases if p in ALL_PHASES and p in IMPLEMENTED_FORKS]
+    run = [
+        p for p in phases
+        if p in (ALL_PHASES + EXPERIMENTAL_PHASES) and p in IMPLEMENTED_FORKS
+    ]
     if DEFAULT_PYTEST_FORKS:
         run = [p for p in run if p in DEFAULT_PYTEST_FORKS]
     return run
@@ -350,7 +383,10 @@ def with_phases(phases, other_phases=None):
             from ..builder import IMPLEMENTED_FORKS
 
             involved = (set(phases) | set(other_phases or [])) & set(IMPLEMENTED_FORKS)
-            phase_dict = {p: build_spec_module(p, preset) for p in ALL_PHASES if p in involved}
+            phase_dict = {
+                p: build_spec_module(p, preset)
+                for p in (ALL_PHASES + EXPERIMENTAL_PHASES) if p in involved
+            }
             ret = None
             for phase in run_phases:
                 spec = build_spec_module(phase, preset)

@@ -5,8 +5,49 @@ def get_process_calls(spec):
     # ordered epoch-processing sub-passes per fork; fork-dependent because
     # the altair namespace still carries phase0's superseded passes
     # (reference specs/phase0/beacon-chain.md:1286-1298; altair:567-583)
-    from .forks import is_post_altair
+    from .forks import is_post_altair, is_post_custody_game, is_post_sharding
 
+    if is_post_custody_game(spec):
+        # custody passes interleave with the sharding/base pipeline
+        # (reference specs/custody_game/beacon-chain.md:616-647)
+        return [
+            'process_pending_shard_confirmations',
+            'reset_pending_shard_work',
+            'process_justification_and_finalization',
+            'process_inactivity_updates',
+            'process_rewards_and_penalties',
+            'process_registry_updates',
+            'process_reveal_deadlines',
+            'process_challenge_deadlines',
+            'process_slashings',
+            'process_eth1_data_reset',
+            'process_effective_balance_updates',
+            'process_slashings_reset',
+            'process_randao_mixes_reset',
+            'process_historical_roots_update',
+            'process_participation_flag_updates',
+            'process_sync_committee_updates',
+            'process_custody_final_updates',
+        ]
+    if is_post_sharding(spec):
+        # sharding pre-processing runs before the base passes
+        # (reference specs/sharding/beacon-chain.md:811-830)
+        return [
+            'process_pending_shard_confirmations',
+            'reset_pending_shard_work',
+            'process_justification_and_finalization',
+            'process_inactivity_updates',
+            'process_rewards_and_penalties',
+            'process_registry_updates',
+            'process_slashings',
+            'process_eth1_data_reset',
+            'process_effective_balance_updates',
+            'process_slashings_reset',
+            'process_randao_mixes_reset',
+            'process_historical_roots_update',
+            'process_participation_flag_updates',
+            'process_sync_committee_updates',
+        ]
     if is_post_altair(spec):
         return [
             'process_justification_and_finalization',

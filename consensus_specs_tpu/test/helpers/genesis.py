@@ -5,7 +5,7 @@
 
 Provenance: adapted from the reference's test/helpers/genesis.py — scenario code and comments largely follow the reference test suite (round-1 port); newer suites in this repo are original.
 """
-from .forks import is_post_altair, is_post_merge
+from .forks import is_post_altair, is_post_custody_game, is_post_merge, is_post_sharding
 from .keys import pubkeys
 
 
@@ -35,6 +35,11 @@ def create_genesis_state(spec, validator_balances, activation_threshold):
     current_version = spec.config.GENESIS_FORK_VERSION
     if spec.fork == "altair":
         current_version = spec.config.ALTAIR_FORK_VERSION
+    elif is_post_sharding(spec):
+        # the draft forks define no fork version of their own (the reference
+        # configs carry only SHARDING_FORK_VERSION) — both drafts run under it
+        previous_version = spec.config.MERGE_FORK_VERSION
+        current_version = spec.config.SHARDING_FORK_VERSION
     elif is_post_merge(spec):
         previous_version = spec.config.ALTAIR_FORK_VERSION
         current_version = spec.config.MERGE_FORK_VERSION
@@ -87,5 +92,23 @@ def create_genesis_state(spec, validator_balances, activation_threshold):
     if is_post_merge(spec):
         # Initialize the execution payload header (with an empty transactions root)
         state.latest_execution_payload_header = spec.ExecutionPayloadHeader()
+
+    if is_post_sharding(spec):
+        # The draft defines no genesis for the shard fee market: start at the
+        # price floor (reference specs/sharding/beacon-chain.md:178 preset);
+        # the shard_buffer default (all SHARD_WORK_UNCONFIRMED) is correct —
+        # the first epoch transition populates pending lists via
+        # reset_pending_shard_work. Blob builders are installed like
+        # validators: deterministic keys, funded to cover test fees.
+        state.shard_sample_price = spec.MIN_SAMPLE_PRICE
+        num_builders = 4
+        state.blob_builders = [
+            spec.Builder(pubkey=pubkeys[-(1 + i)]) for i in range(num_builders)
+        ]
+        state.blob_builder_balances = [spec.Gwei(2**40)] * num_builders
+
+    if is_post_custody_game(spec):
+        for validator in state.validators:
+            validator.all_custody_secrets_revealed_epoch = spec.FAR_FUTURE_EPOCH
 
     return state

@@ -240,3 +240,22 @@ def pairing_check(pairs) -> bool:
     """prod e(P_i, Q_i) == 1 over ((g1 bytes-free affine), (g2 affine)) pairs —
     used by the sharding spec's KZG degree checks."""
     return multi_pairing(pairs) == Fq12.one()
+
+
+@only_with_bls(alt_return=None)
+def Pairing(p, q):
+    """e(P, Q) as a comparable GT element. The sharding draft's
+    `process_shard_header` compares two pairings directly
+    (reference specs/sharding/beacon-chain.md:717-721); py_ecc exposes the
+    same capability, the reference switchboard just never surfaced it
+    because the draft fork is not compiled there. Accepts G1 as compressed
+    Bytes48 or a curve point, G2 as compressed Bytes96 or a curve point."""
+    if isinstance(p, (bytes, bytearray)):
+        p_aff = g1_from_bytes(bytes(p))
+    else:
+        p_aff = p if (p is None or len(p) == 2) else ec_to_affine(p)
+    if isinstance(q, (bytes, bytearray)):
+        q_aff = g2_from_bytes(bytes(q))
+    else:
+        q_aff = q if (q is None or len(q) == 2) else ec_to_affine(q)
+    return oracle.pairing(q_aff, p_aff)

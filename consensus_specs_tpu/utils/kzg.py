@@ -223,14 +223,66 @@ class Setup:
             acc = acc * tau % MODULUS
 
 
+class _LazyPoints:
+    """Indexable view of [tau^i]G computed on demand: the mainnet-shape setup
+    is 16,384 points per group (MAX_SAMPLES_PER_BLOB * POINTS_PER_SAMPLE,
+    sharding/beacon-chain.md:168-175) and the degree check only ever touches
+    a handful of indices, so eager construction would be pure waste."""
+
+    def __init__(self, gen, tau: int, n: int):
+        self._gen = gen
+        self._tau = tau
+        self.n = n
+        self._cache = {}
+
+    def __len__(self) -> int:
+        return self.n
+
+    def __getitem__(self, i: int):
+        if i < 0:
+            i += self.n
+        if not 0 <= i < self.n:
+            raise IndexError(f"setup index {i} out of range (n={self.n})")
+        if i not in self._cache:
+            self._cache[i] = ec_mul(self._gen, pow(self._tau, i, MODULUS))
+        return self._cache[i]
+
+    def __iter__(self):
+        return (self[i] for i in range(self.n))
+
+
+class LazySetup:
+    """Setup-compatible (``.n``/``.g1``/``.g2``) with on-demand points."""
+
+    def __init__(self, tau: int, n: int):
+        self.n = n
+        self.g1 = _LazyPoints(G1_GEN, tau, n)
+        self.g2 = _LazyPoints(G2_GEN, tau, n)
+
+
+_lazy_setup_cache: dict = {}
+
+
+def lazy_setup(tau: int, n: int) -> LazySetup:
+    """Cached per (tau, n) so spec modules and test helpers share one
+    point cache."""
+    if (tau, n) not in _lazy_setup_cache:
+        _lazy_setup_cache[(tau, n)] = LazySetup(tau, n)
+    return _lazy_setup_cache[(tau, n)]
+
+
 def commit_to_poly(setup: Setup, coeffs: Sequence[int]):
     """C = sum c_i * [tau^i]G1 (an MSM — the device analog is a G1 reduction
-    over the batch axis, the same shape as pubkey aggregation)."""
+    over the batch axis, the same shape as pubkey aggregation).
+
+    Zero coefficients are skipped before touching the setup so lazy setups
+    only materialize the points a sparse polynomial (e.g. the degree-proof
+    shift) actually uses."""
     assert len(coeffs) <= setup.n
     acc = None
-    for c, p in zip(coeffs, setup.g1):
+    for i, c in enumerate(coeffs):
         if c % MODULUS:
-            acc = ec_add(acc, ec_mul(p, c % MODULUS))
+            acc = ec_add(acc, ec_mul(setup.g1[i], c % MODULUS))
     return acc if acc is not None else ec_mul(G1_GEN, 0)
 
 
@@ -242,9 +294,9 @@ def commit_to_data(setup: Setup, data: Sequence[int]):
 def _commit_g2(setup: Setup, coeffs: Sequence[int]):
     assert len(coeffs) <= setup.n
     acc = None
-    for c, p in zip(coeffs, setup.g2):
+    for i, c in enumerate(coeffs):
         if c % MODULUS:
-            acc = ec_add(acc, ec_mul(p, c % MODULUS))
+            acc = ec_add(acc, ec_mul(setup.g2[i], c % MODULUS))
     return acc if acc is not None else ec_mul(G2_GEN, 0)
 
 

@@ -988,6 +988,13 @@ class Union(View):
     def value(self):
         return self._value
 
+    def change(self, selector: int, value: Any = None) -> None:
+        """In-place re-tag (remerkleable's Union API, which the sharding
+        draft's ShardWork status transitions use — reference
+        specs/sharding/beacon-chain.md:616-667); propagates to any
+        composite holding this view since composites store by reference."""
+        Union.__init__(self, selector, value)
+
     @classmethod
     def is_fixed_byte_length(cls) -> bool:
         return False

@@ -1,0 +1,1 @@
+from consensus_specs_tpu.test.sharding.block_processing.test_process_attested_shard_work import *  # noqa: F401,F403

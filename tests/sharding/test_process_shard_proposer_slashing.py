@@ -1,0 +1,1 @@
+from consensus_specs_tpu.test.sharding.block_processing.test_process_shard_proposer_slashing import *  # noqa: F401,F403

@@ -1,0 +1,1 @@
+from consensus_specs_tpu.test.sharding.unittests.test_shard_math import *  # noqa: F401,F403

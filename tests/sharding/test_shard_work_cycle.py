@@ -1,0 +1,1 @@
+from consensus_specs_tpu.test.sharding.epoch_processing.test_shard_work_cycle import *  # noqa: F401,F403
