@@ -2,7 +2,7 @@
 
 Provenance: adapted from the reference's test/helpers/block.py — scenario code and comments largely follow the reference test suite (round-1 port); newer suites in this repo are original.
 """
-from .forks import is_post_altair
+from .forks import is_post_altair, is_post_sharding
 from .keys import privkeys
 
 
@@ -77,6 +77,14 @@ def build_empty_block(spec, state, slot=None):
         # participants (reference specs/altair/bls.md:59-68); the default
         # all-zero BLSSignature would fail verification
         empty_block.body.sync_aggregate.sync_committee_signature = spec.G2_POINT_AT_INFINITY
+
+    if is_post_sharding(spec):
+        # sharding+ processes the execution payload unconditionally
+        # ("execution is enabled by default", sharding/beacon-chain.md:545),
+        # so even an "empty" block needs a payload valid at its slot
+        from .execution_payload import build_empty_execution_payload
+
+        empty_block.body.execution_payload = build_empty_execution_payload(spec, state)
 
     apply_randao_reveal(spec, state, empty_block)
     return empty_block

@@ -44,7 +44,7 @@ def get_valid_early_derived_secret_reveal(spec, state, epoch=None):
     signing_root = spec.compute_signing_root(spec.Epoch(epoch), domain)
     reveal = bls.Sign(privkeys[int(revealed_index)], signing_root)
     # any mask that doesn't leak the masker's own secret will do
-    mask = spec.hash(reveal)
+    mask = spec.Bytes32(spec.hash(reveal))
     signing_root = spec.compute_signing_root(mask, domain)
     masker_signature = bls.Sign(privkeys[int(masker_index)], signing_root)
     masked_reveal = bls.Aggregate([reveal, masker_signature])
@@ -56,6 +56,21 @@ def get_valid_early_derived_secret_reveal(spec, state, epoch=None):
         masker_index=masker_index,
         mask=mask,
     )
+
+
+def get_real_custody_secret(spec, state, validator_index, epoch=None):
+    """The validator's actual custody secret. Computed with the BLS switch
+    forced on: compute_custody_bit must decode the secret as a G2 point even
+    in bls-off test runs, so a stub signature won't do."""
+    was_active = bls.bls_active
+    bls.bls_active = True
+    try:
+        return spec.get_custody_secret(
+            state, spec.ValidatorIndex(validator_index),
+            privkeys[int(validator_index)], epoch,
+        )
+    finally:
+        bls.bls_active = was_active
 
 
 def get_sample_custody_data(spec, samples_count, seed=3):
@@ -85,14 +100,16 @@ def get_shard_blob_header_for_data(spec, state, data, slot=None, shard=0):
     )
 
 
-def get_attestation_for_blob_header(spec, state, header, signed=False):
+def get_attestation_for_blob_header(spec, state, header, signed=True):
     """An attestation of the committee for (header.slot, shard->index) voting
-    for the header's root."""
+    for the header's root. Signed AFTER the shard_blob_root is set so the
+    signature stays valid in real-BLS (generator) runs."""
+    from .attestations import sign_attestation
+
     index = spec.compute_committee_index_from_shard(state, header.slot, header.shard)
     attestation = get_valid_attestation(spec, state, slot=header.slot, index=index)
     attestation.data.shard_blob_root = spec.hash_tree_root(header)
     if signed:
-        from .attestations import sign_attestation
         sign_attestation(spec, state, attestation)
     return attestation
 

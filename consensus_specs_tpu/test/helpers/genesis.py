@@ -94,6 +94,12 @@ def create_genesis_state(spec, validator_balances, activation_threshold):
         state.latest_execution_payload_header = spec.ExecutionPayloadHeader()
 
     if is_post_sharding(spec):
+        # sharding assumes execution enabled by default
+        # (sharding/beacon-chain.md:545): genesis starts merge-complete so
+        # every block can carry a chainable payload
+        from .execution_payload import build_state_with_complete_transition
+
+        build_state_with_complete_transition(spec, state)
         # The draft defines no genesis for the shard fee market: start at the
         # price floor (reference specs/sharding/beacon-chain.md:178 preset);
         # the shard_buffer default (all SHARD_WORK_UNCONFIRMED) is correct —

@@ -57,15 +57,17 @@ def sign_shard_blob_header(spec, state, header, builder_index=None, proposer_ind
 
 def build_shard_blob_header(spec, state, slot=None, shard=0, samples_count=1,
                             builder_index=0, max_fee_per_sample=None,
-                            max_priority_fee_per_sample=0, signed=True):
+                            max_priority_fee_per_sample=0, signed=True,
+                            data_seed=7):
     """A processable SignedShardBlobHeader for (slot, shard): real KZG
     commitment + degree proof, correct shard proposer, fees covering the
-    current sample price."""
+    current sample price. Distinct ``data_seed`` values give distinct
+    headers (distinct commitments and roots)."""
     if slot is None:
         slot = state.slot
     slot = spec.Slot(slot)
     shard = spec.Shard(shard)
-    data = get_sample_blob_data(spec, samples_count)
+    data = get_sample_blob_data(spec, samples_count, seed=data_seed)
     commitment, degree_proof = build_data_commitment(spec, data)
     if max_fee_per_sample is None:
         max_fee_per_sample = state.shard_sample_price

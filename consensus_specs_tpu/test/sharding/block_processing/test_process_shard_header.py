@@ -6,7 +6,7 @@ State setup: one epoch transition past genesis so reset_pending_shard_work
 has armed the current epoch's (slot, shard) slots with SHARD_WORK_PENDING
 lists (beacon-chain.md:846-888).
 """
-from ...context import SHARDING, always_bls, expect_assertion_error, spec_state_test, with_phases
+from ...context import CUSTODY_GAME, SHARDING, always_bls, expect_assertion_error, spec_state_test, with_phases
 from ...helpers.shard_blob import (
     build_data_commitment,
     build_shard_blob_header,
@@ -41,7 +41,7 @@ def _pending_headers(spec, state, slot, shard):
     return work.status.value
 
 
-@with_phases([SHARDING])
+@with_phases([SHARDING, CUSTODY_GAME])
 @spec_state_test
 def test_shard_header_accepted(spec, state):
     _armed_state(spec, state)
@@ -64,7 +64,7 @@ def test_shard_header_accepted(spec, state):
     )
 
 
-@with_phases([SHARDING])
+@with_phases([SHARDING, CUSTODY_GAME])
 @spec_state_test
 def test_shard_header_priority_fee_paid_to_proposer(spec, state):
     _armed_state(spec, state)
@@ -88,7 +88,7 @@ def test_shard_header_priority_fee_paid_to_proposer(spec, state):
     )
 
 
-@with_phases([SHARDING])
+@with_phases([SHARDING, CUSTODY_GAME])
 @spec_state_test
 @always_bls
 def test_shard_header_accepted_real_crypto(spec, state):
@@ -99,7 +99,7 @@ def test_shard_header_accepted_real_crypto(spec, state):
     yield from run_shard_header_processing(spec, state, signed)
 
 
-@with_phases([SHARDING])
+@with_phases([SHARDING, CUSTODY_GAME])
 @spec_state_test
 @always_bls
 def test_shard_header_invalid_degree_proof(spec, state):
@@ -113,7 +113,7 @@ def test_shard_header_invalid_degree_proof(spec, state):
     yield from run_shard_header_processing(spec, state, signed, valid=False)
 
 
-@with_phases([SHARDING])
+@with_phases([SHARDING, CUSTODY_GAME])
 @spec_state_test
 @always_bls
 def test_shard_header_bad_signature(spec, state):
@@ -123,7 +123,7 @@ def test_shard_header_bad_signature(spec, state):
     yield from run_shard_header_processing(spec, state, signed, valid=False)
 
 
-@with_phases([SHARDING])
+@with_phases([SHARDING, CUSTODY_GAME])
 @spec_state_test
 def test_shard_header_zero_slot(spec, state):
     _armed_state(spec, state)
@@ -132,7 +132,7 @@ def test_shard_header_zero_slot(spec, state):
     yield from run_shard_header_processing(spec, state, signed, valid=False)
 
 
-@with_phases([SHARDING])
+@with_phases([SHARDING, CUSTODY_GAME])
 @spec_state_test
 def test_shard_header_future_slot(spec, state):
     _armed_state(spec, state)
@@ -141,7 +141,7 @@ def test_shard_header_future_slot(spec, state):
     yield from run_shard_header_processing(spec, state, signed, valid=False)
 
 
-@with_phases([SHARDING])
+@with_phases([SHARDING, CUSTODY_GAME])
 @spec_state_test
 def test_shard_header_stale_epoch(spec, state):
     # two epochs past the header's slot: epoch is neither previous nor current
@@ -153,7 +153,7 @@ def test_shard_header_stale_epoch(spec, state):
     yield from run_shard_header_processing(spec, state, signed, valid=False)
 
 
-@with_phases([SHARDING])
+@with_phases([SHARDING, CUSTODY_GAME])
 @spec_state_test
 def test_shard_header_invalid_shard(spec, state):
     _armed_state(spec, state)
@@ -162,7 +162,7 @@ def test_shard_header_invalid_shard(spec, state):
     yield from run_shard_header_processing(spec, state, signed, valid=False)
 
 
-@with_phases([SHARDING])
+@with_phases([SHARDING, CUSTODY_GAME])
 @spec_state_test
 def test_shard_header_not_pending(spec, state):
     _armed_state(spec, state)
@@ -176,7 +176,7 @@ def test_shard_header_not_pending(spec, state):
     yield from run_shard_header_processing(spec, state, signed, valid=False)
 
 
-@with_phases([SHARDING])
+@with_phases([SHARDING, CUSTODY_GAME])
 @spec_state_test
 def test_shard_header_duplicate(spec, state):
     _armed_state(spec, state)
@@ -186,7 +186,7 @@ def test_shard_header_duplicate(spec, state):
     yield from run_shard_header_processing(spec, state, signed, valid=False)
 
 
-@with_phases([SHARDING])
+@with_phases([SHARDING, CUSTODY_GAME])
 @spec_state_test
 def test_shard_header_wrong_proposer(spec, state):
     _armed_state(spec, state)
@@ -195,7 +195,7 @@ def test_shard_header_wrong_proposer(spec, state):
     yield from run_shard_header_processing(spec, state, signed, valid=False)
 
 
-@with_phases([SHARDING])
+@with_phases([SHARDING, CUSTODY_GAME])
 @spec_state_test
 def test_shard_header_insufficient_builder_balance(spec, state):
     _armed_state(spec, state)
@@ -204,7 +204,7 @@ def test_shard_header_insufficient_builder_balance(spec, state):
     yield from run_shard_header_processing(spec, state, signed, valid=False)
 
 
-@with_phases([SHARDING])
+@with_phases([SHARDING, CUSTODY_GAME])
 @spec_state_test
 def test_shard_header_max_fee_below_base_fee(spec, state):
     _armed_state(spec, state)
@@ -215,7 +215,7 @@ def test_shard_header_max_fee_below_base_fee(spec, state):
     yield from run_shard_header_processing(spec, state, signed, valid=False)
 
 
-@with_phases([SHARDING])
+@with_phases([SHARDING, CUSTODY_GAME])
 @spec_state_test
 @always_bls
 def test_shard_header_oversized_samples_count(spec, state):
@@ -229,22 +229,15 @@ def test_shard_header_oversized_samples_count(spec, state):
     yield from run_shard_header_processing(spec, state, signed, valid=False)
 
 
-@with_phases([SHARDING])
+@with_phases([SHARDING, CUSTODY_GAME])
 @spec_state_test
 def test_shard_header_pending_list_full(spec, state):
     _armed_state(spec, state)
     slot = state.slot - 1
     for seed in range(int(spec.MAX_SHARD_HEADERS_PER_SHARD) - 1):  # one dummy pre-exists
-        data = get_sample_blob_data(spec, samples_count=1, seed=1000 + seed)
-        commitment, proof = build_data_commitment(spec, data)
-        signed = build_shard_blob_header(spec, state, slot=slot, shard=0)
-        signed.message.body_summary.commitment = commitment
-        signed.message.body_summary.degree_proof = proof
+        signed = build_shard_blob_header(spec, state, slot=slot, shard=0,
+                                         data_seed=1000 + seed)
         spec.process_shard_header(state, signed)
     # list is now at MAX_SHARD_HEADERS_PER_SHARD: the next append must fail
-    data = get_sample_blob_data(spec, samples_count=1, seed=4242)
-    commitment, proof = build_data_commitment(spec, data)
-    signed = build_shard_blob_header(spec, state, slot=slot, shard=0)
-    signed.message.body_summary.commitment = commitment
-    signed.message.body_summary.degree_proof = proof
+    signed = build_shard_blob_header(spec, state, slot=slot, shard=0, data_seed=4242)
     yield from run_shard_header_processing(spec, state, signed, valid=False)

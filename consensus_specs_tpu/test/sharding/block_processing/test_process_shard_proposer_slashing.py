@@ -1,6 +1,6 @@
 """process_shard_proposer_slashing tests (original; reference
 specs/sharding/beacon-chain.md:771-806)."""
-from ...context import SHARDING, always_bls, expect_assertion_error, spec_state_test, with_phases
+from ...context import CUSTODY_GAME, SHARDING, always_bls, expect_assertion_error, spec_state_test, with_phases
 from ...helpers.shard_blob import build_shard_proposer_slashing
 from ...helpers.state import next_epoch, next_slot
 
@@ -25,7 +25,7 @@ def _prep(spec, state):
     next_slot(spec, state)
 
 
-@with_phases([SHARDING])
+@with_phases([SHARDING, CUSTODY_GAME])
 @spec_state_test
 def test_shard_proposer_slashing_accepted(spec, state):
     _prep(spec, state)
@@ -38,7 +38,7 @@ def test_shard_proposer_slashing_accepted(spec, state):
     assert state.validators[proposer].slashed
 
 
-@with_phases([SHARDING])
+@with_phases([SHARDING, CUSTODY_GAME])
 @spec_state_test
 @always_bls
 def test_shard_proposer_slashing_accepted_real_signatures(spec, state):
@@ -48,7 +48,7 @@ def test_shard_proposer_slashing_accepted_real_signatures(spec, state):
     assert state.validators[slashing.proposer_index].slashed
 
 
-@with_phases([SHARDING])
+@with_phases([SHARDING, CUSTODY_GAME])
 @spec_state_test
 def test_shard_proposer_slashing_identical_references(spec, state):
     _prep(spec, state)
@@ -59,7 +59,7 @@ def test_shard_proposer_slashing_identical_references(spec, state):
     yield from run_shard_proposer_slashing_processing(spec, state, slashing, valid=False)
 
 
-@with_phases([SHARDING])
+@with_phases([SHARDING, CUSTODY_GAME])
 @spec_state_test
 def test_shard_proposer_slashing_already_slashed(spec, state):
     _prep(spec, state)
@@ -68,7 +68,7 @@ def test_shard_proposer_slashing_already_slashed(spec, state):
     yield from run_shard_proposer_slashing_processing(spec, state, slashing, valid=False)
 
 
-@with_phases([SHARDING])
+@with_phases([SHARDING, CUSTODY_GAME])
 @spec_state_test
 def test_shard_proposer_slashing_withdrawn_proposer(spec, state):
     _prep(spec, state)
@@ -79,7 +79,7 @@ def test_shard_proposer_slashing_withdrawn_proposer(spec, state):
     yield from run_shard_proposer_slashing_processing(spec, state, slashing, valid=False)
 
 
-@with_phases([SHARDING])
+@with_phases([SHARDING, CUSTODY_GAME])
 @spec_state_test
 @always_bls
 def test_shard_proposer_slashing_bad_signature_1(spec, state):
@@ -89,7 +89,7 @@ def test_shard_proposer_slashing_bad_signature_1(spec, state):
     yield from run_shard_proposer_slashing_processing(spec, state, slashing, valid=False)
 
 
-@with_phases([SHARDING])
+@with_phases([SHARDING, CUSTODY_GAME])
 @spec_state_test
 @always_bls
 def test_shard_proposer_slashing_swapped_builders(spec, state):

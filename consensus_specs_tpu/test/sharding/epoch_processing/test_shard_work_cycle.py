@@ -1,7 +1,7 @@
 """Epoch-boundary shard-work lifecycle: stale-header resolution and the
 pending-work reset (original; reference
 specs/sharding/beacon-chain.md:832-888)."""
-from ...context import SHARDING, spec_state_test, with_phases
+from ...context import CUSTODY_GAME, SHARDING, spec_state_test, with_phases
 from ...helpers.attestations import get_valid_attestation
 from ...helpers.epoch_processing import run_epoch_processing_to, run_epoch_processing_with
 from ...helpers.shard_blob import build_shard_blob_header
@@ -17,7 +17,7 @@ def _work(spec, state, slot, shard):
     return state.shard_buffer[int(slot) % int(spec.SHARD_STATE_MEMORY_SLOTS)][int(shard)]
 
 
-@with_phases([SHARDING])
+@with_phases([SHARDING, CUSTODY_GAME])
 @spec_state_test
 def test_reset_pending_shard_work_arms_next_epoch(spec, state):
     yield from run_epoch_processing_with(spec, state, 'reset_pending_shard_work')
@@ -40,7 +40,7 @@ def test_reset_pending_shard_work_arms_next_epoch(spec, state):
                 assert work.status.selector == spec.SHARD_WORK_UNCONFIRMED
 
 
-@with_phases([SHARDING])
+@with_phases([SHARDING, CUSTODY_GAME])
 @spec_state_test
 def test_stale_unvoted_epoch_resolves_unconfirmed(spec, state):
     _armed_state(spec, state)
@@ -58,7 +58,7 @@ def test_stale_unvoted_epoch_resolves_unconfirmed(spec, state):
     assert _work(spec, state, slot, 0).status.selector == spec.SHARD_WORK_UNCONFIRMED
 
 
-@with_phases([SHARDING])
+@with_phases([SHARDING, CUSTODY_GAME])
 @spec_state_test
 def test_stale_voted_header_wins_confirmation(spec, state):
     _armed_state(spec, state)
@@ -89,7 +89,7 @@ def test_stale_voted_header_wins_confirmation(spec, state):
     assert work.status.value.root == header_root
 
 
-@with_phases([SHARDING])
+@with_phases([SHARDING, CUSTODY_GAME])
 @spec_state_test
 def test_genesis_epoch_skips_confirmations(spec, state):
     # at GENESIS_EPOCH there is no prior epoch to resolve — the pass is a no-op
@@ -99,7 +99,7 @@ def test_genesis_epoch_skips_confirmations(spec, state):
     assert state.shard_buffer == pre
 
 
-@with_phases([SHARDING])
+@with_phases([SHARDING, CUSTODY_GAME])
 @spec_state_test
 def test_full_epoch_transition_keeps_ring_buffer_consistent(spec, state):
     # three epoch transitions: every currently-armed slot is pending, and the

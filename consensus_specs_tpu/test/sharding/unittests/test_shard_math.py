@@ -2,11 +2,11 @@
 EIP-1559-style sample-price update (original tests against reference
 specs/sharding/beacon-chain.md:433-540; the reference's own sharding
 unittest file targets a stale earlier draft and cannot run there)."""
-from ...context import SHARDING, spec_state_test, with_phases
+from ...context import CUSTODY_GAME, SHARDING, spec_state_test, with_phases
 from ...helpers.state import next_epoch
 
 
-@with_phases([SHARDING])
+@with_phases([SHARDING, CUSTODY_GAME])
 @spec_state_test
 def test_active_shard_count_bounds_committees(spec, state):
     epoch = spec.get_current_epoch(state)
@@ -14,7 +14,7 @@ def test_active_shard_count_bounds_committees(spec, state):
     assert 1 <= count <= spec.get_active_shard_count(state, epoch)
 
 
-@with_phases([SHARDING])
+@with_phases([SHARDING, CUSTODY_GAME])
 @spec_state_test
 def test_get_start_shard_wraps_by_committee_count(spec, state):
     epoch = spec.get_current_epoch(state)
@@ -26,7 +26,7 @@ def test_get_start_shard_wraps_by_committee_count(spec, state):
         )
 
 
-@with_phases([SHARDING])
+@with_phases([SHARDING, CUSTODY_GAME])
 @spec_state_test
 def test_shard_committee_index_roundtrip(spec, state):
     next_epoch(spec, state)
@@ -41,7 +41,7 @@ def test_shard_committee_index_roundtrip(spec, state):
         assert back == index
 
 
-@with_phases([SHARDING])
+@with_phases([SHARDING, CUSTODY_GAME])
 @spec_state_test
 def test_compute_shard_rejects_out_of_range_index(spec, state):
     epoch = spec.get_current_epoch(state)
@@ -54,7 +54,7 @@ def test_compute_shard_rejects_out_of_range_index(spec, state):
     assert raised
 
 
-@with_phases([SHARDING])
+@with_phases([SHARDING, CUSTODY_GAME])
 @spec_state_test
 def test_sample_price_at_target_is_stable_or_floor_bound(spec, state):
     active = spec.get_active_shard_count(state, spec.get_current_epoch(state))
@@ -67,7 +67,7 @@ def test_sample_price_at_target_is_stable_or_floor_bound(spec, state):
     assert spec.MIN_SAMPLE_PRICE <= updated <= price
 
 
-@with_phases([SHARDING])
+@with_phases([SHARDING, CUSTODY_GAME])
 @spec_state_test
 def test_sample_price_rises_above_target_and_caps(spec, state):
     active = spec.get_active_shard_count(state, spec.get_current_epoch(state))
@@ -81,7 +81,7 @@ def test_sample_price_rises_above_target_and_caps(spec, state):
     assert capped == spec.MAX_SAMPLE_PRICE
 
 
-@with_phases([SHARDING])
+@with_phases([SHARDING, CUSTODY_GAME])
 @spec_state_test
 def test_sample_price_falls_below_target_and_floors(spec, state):
     active = spec.get_active_shard_count(state, spec.get_current_epoch(state))
@@ -95,7 +95,7 @@ def test_sample_price_falls_below_target_and_floors(spec, state):
     assert floored <= spec.MIN_SAMPLE_PRICE
 
 
-@with_phases([SHARDING])
+@with_phases([SHARDING, CUSTODY_GAME])
 @spec_state_test
 def test_committee_source_epoch_lookahead(spec, state):
     period = spec.uint64(8)
@@ -107,7 +107,7 @@ def test_committee_source_epoch_lookahead(spec, state):
     assert spec.compute_committee_source_epoch(spec.Epoch(24), period) == 16
 
 
-@with_phases([SHARDING])
+@with_phases([SHARDING, CUSTODY_GAME])
 @spec_state_test
 def test_next_power_of_two_and_previous_slot(spec, state):
     assert spec.next_power_of_two(1) == 1
@@ -118,7 +118,7 @@ def test_next_power_of_two_and_previous_slot(spec, state):
     assert spec.compute_previous_slot(spec.Slot(5)) == 4
 
 
-@with_phases([SHARDING])
+@with_phases([SHARDING, CUSTODY_GAME])
 @spec_state_test
 def test_shard_proposer_is_active_validator(spec, state):
     next_epoch(spec, state)
@@ -129,7 +129,7 @@ def test_shard_proposer_is_active_validator(spec, state):
         assert proposer in active
 
 
-@with_phases([SHARDING])
+@with_phases([SHARDING, CUSTODY_GAME])
 @spec_state_test
 def test_participation_flags_extended(spec, state):
     assert len(spec.PARTICIPATION_FLAG_WEIGHTS) == 4

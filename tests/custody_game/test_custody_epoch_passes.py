@@ -1,0 +1,1 @@
+from consensus_specs_tpu.test.custody_game.epoch_processing.test_custody_epoch_passes import *  # noqa: F401,F403

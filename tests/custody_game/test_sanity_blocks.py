@@ -1,0 +1,1 @@
+from consensus_specs_tpu.test.custody_game.sanity.test_blocks import *  # noqa: F401,F403
