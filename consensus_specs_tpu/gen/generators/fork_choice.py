@@ -11,7 +11,9 @@ MODS = {
     "get_head": f"{_T}.phase0.fork_choice.test_get_head",
     "on_block": f"{_T}.phase0.fork_choice.test_on_block",
 }
-ALL_MODS = {fork: MODS for fork in ("phase0", "altair", "merge")}
+ALL_MODS = {fork: MODS for fork in ("phase0", "altair")}
+# the terminal-PoW on_block cases only exist from the merge on
+ALL_MODS["merge"] = dict(MODS, on_merge_block=f"{_T}.merge.fork_choice.test_on_merge_block")
 
 
 def main(args=None) -> int:

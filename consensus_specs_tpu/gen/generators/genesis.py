@@ -7,6 +7,7 @@ _T = "consensus_specs_tpu.test"
 
 ALL_MODS = {
     "phase0": {"initialization": f"{_T}.phase0.genesis.test_genesis"},
+    "merge": {"initialization": f"{_T}.merge.genesis.test_initialization"},
 }
 
 

@@ -195,3 +195,128 @@ def test_sync_committee_proposer_in_committee(spec, state):
     yield from run_sync_aggregate_processing(spec, state, sync_aggregate)
     # informational: whether the proposer held a seat in this committee
     _ = proposer in committee_indices
+
+
+def _random_bits(spec, fraction_num, fraction_den, seed):
+    """Deterministic participation pattern covering fraction_num/fraction_den
+    of the committee."""
+    size = int(spec.SYNC_COMMITTEE_SIZE)
+    return [
+        ((i * 2654435761 + seed * 40503) % fraction_den) < fraction_num
+        for i in range(size)
+    ]
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+def test_sync_committee_random_three_quarters(spec, state):
+    _prepare(spec, state)
+    bits = _random_bits(spec, 3, 4, seed=1)
+    yield from run_sync_aggregate_processing(
+        spec, state, build_sync_aggregate(spec, state, bits)
+    )
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+def test_sync_committee_random_quarter(spec, state):
+    _prepare(spec, state)
+    bits = _random_bits(spec, 1, 4, seed=2)
+    yield from run_sync_aggregate_processing(
+        spec, state, build_sync_aggregate(spec, state, bits)
+    )
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+def test_sync_committee_single_participant(spec, state):
+    _prepare(spec, state)
+    bits = [False] * int(spec.SYNC_COMMITTEE_SIZE)
+    bits[0] = True
+    yield from run_sync_aggregate_processing(
+        spec, state, build_sync_aggregate(spec, state, bits)
+    )
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+def test_sync_committee_all_but_one(spec, state):
+    _prepare(spec, state)
+    bits = [True] * int(spec.SYNC_COMMITTEE_SIZE)
+    bits[-1] = False
+    yield from run_sync_aggregate_processing(
+        spec, state, build_sync_aggregate(spec, state, bits)
+    )
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+def test_sync_committee_with_slashed_participant(spec, state):
+    # slashing does not evict a sync-committee seat: a slashed member still
+    # participates and is paid the seat reward
+    _prepare(spec, state)
+    committee = get_committee_indices(spec, state)
+    state.validators[committee[0]].slashed = True
+    bits = [True] * int(spec.SYNC_COMMITTEE_SIZE)
+    yield from run_sync_aggregate_processing(
+        spec, state, build_sync_aggregate(spec, state, bits)
+    )
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+def test_sync_committee_with_exited_participant(spec, state):
+    _prepare(spec, state)
+    committee = get_committee_indices(spec, state)
+    validator = state.validators[committee[0]]
+    validator.exit_epoch = spec.get_current_epoch(state)
+    bits = [True] * int(spec.SYNC_COMMITTEE_SIZE)
+    yield from run_sync_aggregate_processing(
+        spec, state, build_sync_aggregate(spec, state, bits)
+    )
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+@always_bls
+def test_invalid_signature_wrong_domain(spec, state):
+    # correct message, wrong domain: signed under DOMAIN_BEACON_ATTESTER
+    _prepare(spec, state)
+    from ...helpers.keys import privkeys
+
+    bits = [True] * int(spec.SYNC_COMMITTEE_SIZE)
+    committee = get_committee_indices(spec, state)
+    previous_slot = state.slot - 1
+    block_root = spec.get_block_root_at_slot(state, previous_slot)
+    domain = spec.get_domain(
+        state, spec.DOMAIN_BEACON_ATTESTER, spec.compute_epoch_at_slot(previous_slot)
+    )
+    signing_root = spec.compute_signing_root(spec.Root(block_root), domain)
+    signature = spec.bls.Aggregate([
+        spec.bls.Sign(privkeys[index], signing_root) for index in committee
+    ])
+    aggregate = spec.SyncAggregate(
+        sync_committee_bits=bits, sync_committee_signature=signature,
+    )
+    yield from run_sync_aggregate_processing(spec, state, aggregate, valid=False)
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+def test_proposer_reward_sums_over_participants(spec, state):
+    _prepare(spec, state)
+    bits = _random_bits(spec, 1, 2, seed=3)
+    proposer_index = spec.get_beacon_proposer_index(state)
+    committee = get_committee_indices(spec, state)
+    # keep the proposer out of the committee accounting for a clean check
+    if proposer_index in committee:
+        import pytest
+        pytest.skip("proposer holds a committee seat in this state")
+    _, proposer_reward = compute_sync_committee_participant_reward_and_penalty(spec, state)
+    pre = int(state.balances[proposer_index])
+
+    yield from run_sync_aggregate_processing(
+        spec, state, build_sync_aggregate(spec, state, bits)
+    )
+
+    assert int(state.balances[proposer_index]) == pre + sum(bits) * int(proposer_reward)

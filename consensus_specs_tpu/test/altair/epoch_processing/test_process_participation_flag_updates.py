@@ -41,3 +41,67 @@ def test_rotation_all_zeroed(spec, state):
     )
     assert list(state.previous_epoch_participation) == [spec.ParticipationFlags(0)] * n
     assert list(state.current_epoch_participation) == [spec.ParticipationFlags(0)] * n
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+def test_rotation_large_random(spec, state):
+    _randomize_flags(spec, state, Random(40404))
+    pre_current = list(state.current_epoch_participation)
+    pre_previous = list(state.previous_epoch_participation)
+    yield from run_epoch_processing_with(
+        spec, state, 'process_participation_flag_updates'
+    )
+    # old previous-epoch flags are gone entirely
+    assert list(state.previous_epoch_participation) == pre_current
+    assert list(state.previous_epoch_participation) != pre_previous
+    assert all(int(f) == 0 for f in state.current_epoch_participation)
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+def test_rotation_tracks_registry_growth(spec, state):
+    # deposits grow the registry (and both flag lists) mid-epoch; the
+    # rotation must carry the longer current list into previous and zero a
+    # fresh list of the same grown length
+    from ...helpers.deposits import build_deposit_data
+    from ...helpers.keys import privkeys, pubkeys
+
+    _randomize_flags(spec, state, Random(99))
+    n = len(state.validators)
+    grown = n + 2
+    for i in range(n, grown):
+        # mirror process_deposit's registry append
+        state.validators.append(spec.get_validator_from_deposit(
+            state,
+            spec.Deposit(data=build_deposit_data(
+                spec, pubkeys[i],
+                privkeys[i],
+                spec.MAX_EFFECTIVE_BALANCE,
+                spec.BLS_WITHDRAWAL_PREFIX + spec.hash(pubkeys[i])[1:],
+                signed=True,
+            )),
+        ))
+        state.balances.append(spec.MAX_EFFECTIVE_BALANCE)
+        state.previous_epoch_participation.append(spec.ParticipationFlags(0))
+        state.current_epoch_participation.append(spec.ParticipationFlags(0b101))
+        state.inactivity_scores.append(spec.uint64(0))
+    pre_current = list(state.current_epoch_participation)
+
+    yield from run_epoch_processing_with(
+        spec, state, 'process_participation_flag_updates'
+    )
+    assert list(state.previous_epoch_participation) == pre_current
+    assert len(state.current_epoch_participation) == grown
+    assert all(int(f) == 0 for f in state.current_epoch_participation)
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+def test_double_rotation_clears_everything(spec, state):
+    _randomize_flags(spec, state, Random(7))
+    n = len(state.validators)
+    spec.process_participation_flag_updates(state)
+    spec.process_participation_flag_updates(state)
+    assert list(state.previous_epoch_participation) == [spec.ParticipationFlags(0)] * n
+    assert list(state.current_epoch_participation) == [spec.ParticipationFlags(0)] * n

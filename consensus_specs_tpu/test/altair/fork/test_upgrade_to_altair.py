@@ -71,3 +71,79 @@ def test_upgrade_translates_participation(spec, state, phases):
         attesters |= set(spec.get_attesting_indices(state, att.data, att.aggregation_bits))
     assert set(flagged) <= attesters
     yield 'post', post
+
+
+def _randomize_pre_state(spec, state, seed):
+    from random import Random
+
+    rng = Random(seed)
+    for index in rng.sample(range(len(state.validators)), len(state.validators) // 4):
+        v = state.validators[index]
+        choice = rng.randrange(4)
+        if choice == 0:
+            v.slashed = True
+            v.exit_epoch = spec.get_current_epoch(state)
+            v.withdrawable_epoch = spec.get_current_epoch(state) + 16
+        elif choice == 1:
+            v.exit_epoch = spec.get_current_epoch(state) + rng.randrange(1, 8)
+        elif choice == 2:
+            v.activation_epoch = spec.FAR_FUTURE_EPOCH
+            v.activation_eligibility_epoch = spec.get_current_epoch(state) + 1
+        state.balances[index] = spec.Gwei(rng.randrange(1, 2 * 10**9))
+
+
+@with_phases([PHASE0], other_phases=[ALTAIR])
+@spec_state_test
+def test_upgrade_random_registry_low(spec, state, phases):
+    next_epoch(spec, state)
+    _randomize_pre_state(spec, state, seed=101)
+    yield 'pre', state
+    post = _upgrade(phases, state)
+    yield 'post', post
+    # registry content carried over field-for-field
+    for pre_v, post_v in zip(state.validators, post.validators):
+        assert pre_v.pubkey == post_v.pubkey
+        assert pre_v.slashed == post_v.slashed
+        assert pre_v.exit_epoch == post_v.exit_epoch
+
+
+@with_phases([PHASE0], other_phases=[ALTAIR])
+@spec_state_test
+def test_upgrade_random_registry_alt_seed(spec, state, phases):
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    _randomize_pre_state(spec, state, seed=202)
+    yield 'pre', state
+    post = _upgrade(phases, state)
+    yield 'post', post
+
+
+@with_phases([PHASE0], other_phases=[ALTAIR])
+@spec_state_test
+def test_upgrade_preserves_finality_and_history(spec, state, phases):
+    state, _, post_state = next_epoch_with_attestations(spec, state, True, False)
+    state = post_state
+    state, _, post_state = next_epoch_with_attestations(spec, state, True, False)
+    state = post_state
+    yield 'pre', state
+    post = _upgrade(phases, state)
+    yield 'post', post
+    assert post.finalized_checkpoint == state.finalized_checkpoint
+    assert post.current_justified_checkpoint == state.current_justified_checkpoint
+    assert list(post.block_roots) == list(state.block_roots)
+    assert list(post.state_roots) == list(state.state_roots)
+    assert post.eth1_data == state.eth1_data
+
+
+@with_phases([PHASE0], other_phases=[ALTAIR])
+@spec_state_test
+def test_upgrade_mid_epoch_slot(spec, state, phases):
+    from ...helpers.state import next_slot
+
+    next_epoch(spec, state)
+    for _ in range(3):
+        next_slot(spec, state)
+    yield 'pre', state
+    post = _upgrade(phases, state)
+    yield 'post', post
+    assert post.slot == state.slot

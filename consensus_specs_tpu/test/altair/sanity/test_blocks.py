@@ -60,3 +60,77 @@ def _advanced(spec, state, slot):
     tmp = state.copy()
     spec.process_slots(tmp, slot)
     return tmp
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+def test_multiple_empty_epochs(spec, state):
+    from ...helpers.state import next_epoch_via_block
+
+    yield 'pre', state
+    blocks = []
+    for _ in range(3):
+        blocks.append(next_epoch_via_block(spec, state))
+    yield 'blocks', blocks
+    yield 'post', state
+    assert spec.get_current_epoch(state) == 3
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+def test_block_with_attestation_and_exit_mix(spec, state):
+    from ...helpers.attestations import get_valid_attestation
+    from ...helpers.state import next_epoch, next_slot, transition_to
+    from ...helpers.voluntary_exits import prepare_signed_exits
+
+    # age the validators past the exit-eligibility threshold
+    for _ in range(int(spec.config.SHARD_COMMITTEE_PERIOD) + 1):
+        next_epoch(spec, state)
+    next_slot(spec, state)
+
+    attestation = get_valid_attestation(spec, state, slot=state.slot - 1, signed=True)
+    exits = prepare_signed_exits(spec, state, [len(state.validators) - 1])
+
+    yield 'pre', state
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.attestations = [attestation]
+    block.body.voluntary_exits = exits
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield 'blocks', [signed_block]
+    yield 'post', state
+
+    assert state.validators[len(state.validators) - 1].exit_epoch < spec.FAR_FUTURE_EPOCH
+    attesting = spec.get_attesting_indices(
+        state, attestation.data, attestation.aggregation_bits
+    )
+    for index in attesting:
+        assert spec.has_flag(
+            state.previous_epoch_participation[index]
+            if attestation.data.target.epoch < spec.get_current_epoch(state)
+            else state.current_epoch_participation[index],
+            spec.TIMELY_SOURCE_FLAG_INDEX,
+        )
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+def test_empty_sync_aggregate_accepted(spec, state):
+    # zero participation with the infinity signature is a legal block
+    yield 'pre', state
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield 'blocks', [signed_block]
+    yield 'post', state
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+def test_inactivity_scores_grow_through_empty_leak_epochs(spec, state):
+    from ...helpers.state import next_epoch
+
+    # no attestations for > MIN_EPOCHS_TO_INACTIVITY_PENALTY: the leak arms
+    # and scores climb for everyone
+    for _ in range(int(spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY) + 3):
+        next_epoch(spec, state)
+    assert spec.is_in_inactivity_leak(state)
+    assert all(int(s) > 0 for s in state.inactivity_scores)

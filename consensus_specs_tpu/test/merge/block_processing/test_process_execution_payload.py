@@ -140,3 +140,129 @@ def test_bad_execution_rejected(spec, state):
     yield from run_execution_payload_processing(
         spec, state, payload, valid=False, execution_engine=RejectingEngine()
     )
+
+
+@with_phases([MERGE])
+@spec_state_test
+def test_success_payload_with_transactions(spec, state):
+    build_state_with_complete_transition(spec, state)
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.transactions = [
+        spec.Transaction(b'\x99' * 16),
+        spec.Transaction(b'\x01'),
+        spec.Transaction(b'\xab' * 64),
+    ]
+    payload.block_hash = spec.Hash32(
+        spec.hash(payload.hash_tree_root() + b"FAKE RLP HASH")
+    )
+    yield from run_execution_payload_processing(spec, state, payload)
+
+
+@with_phases([MERGE])
+@spec_state_test
+def test_success_max_extra_data(spec, state):
+    build_state_with_complete_transition(spec, state)
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.extra_data = b'\x45' * int(spec.MAX_EXTRA_DATA_BYTES)
+    yield from run_execution_payload_processing(spec, state, payload)
+
+
+@with_phases([MERGE])
+@spec_state_test
+def test_success_gas_limit_upper_edge(spec, state):
+    # one below the +1/1024 jump ceiling is legal
+    build_state_with_complete_transition(spec, state)
+    next_slot(spec, state)
+    parent = state.latest_execution_payload_header
+    payload = build_empty_execution_payload(spec, state)
+    payload.gas_limit = (
+        parent.gas_limit + parent.gas_limit // spec.GAS_LIMIT_DENOMINATOR - 1
+    )
+    yield from run_execution_payload_processing(spec, state, payload)
+
+
+@with_phases([MERGE])
+@spec_state_test
+def test_success_gas_limit_lower_edge(spec, state):
+    build_state_with_complete_transition(spec, state)
+    next_slot(spec, state)
+    parent = state.latest_execution_payload_header
+    payload = build_empty_execution_payload(spec, state)
+    payload.gas_limit = (
+        parent.gas_limit - parent.gas_limit // spec.GAS_LIMIT_DENOMINATOR + 1
+    )
+    yield from run_execution_payload_processing(spec, state, payload)
+
+
+@with_phases([MERGE])
+@spec_state_test
+def test_invalid_gas_limit_drop_too_large(spec, state):
+    build_state_with_complete_transition(spec, state)
+    next_slot(spec, state)
+    parent = state.latest_execution_payload_header
+    payload = build_empty_execution_payload(spec, state)
+    payload.gas_limit = (
+        parent.gas_limit - parent.gas_limit // spec.GAS_LIMIT_DENOMINATOR
+    )
+    yield from run_execution_payload_processing(spec, state, payload, valid=False)
+
+
+@with_phases([MERGE])
+@spec_state_test
+def test_invalid_gas_limit_below_minimum(spec, state):
+    build_state_with_complete_transition(spec, state)
+    # shrink the parent limit to the floor, then dip under it
+    state.latest_execution_payload_header.gas_limit = spec.MIN_GAS_LIMIT
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.gas_limit = spec.uint64(int(spec.MIN_GAS_LIMIT) - 1)
+    yield from run_execution_payload_processing(spec, state, payload, valid=False)
+
+
+@with_phases([MERGE])
+@spec_state_test
+def test_invalid_first_payload_bad_random(spec, state):
+    # even the transition payload must carry the right randao mix
+    build_state_with_incomplete_transition(spec, state)
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.random = b'\x12' * 32
+    yield from run_execution_payload_processing(spec, state, payload, valid=False)
+
+
+@with_phases([MERGE])
+@spec_state_test
+def test_invalid_first_payload_bad_timestamp(spec, state):
+    build_state_with_incomplete_transition(spec, state)
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.timestamp = payload.timestamp + 1
+    yield from run_execution_payload_processing(spec, state, payload, valid=False)
+
+
+@with_phases([MERGE])
+@spec_state_test
+def test_invalid_future_block_number(spec, state):
+    build_state_with_complete_transition(spec, state)
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.block_number = payload.block_number + 10
+    yield from run_execution_payload_processing(spec, state, payload, valid=False)
+
+
+@with_phases([MERGE])
+@spec_state_test
+def test_header_reflects_transactions_root(spec, state):
+    build_state_with_complete_transition(spec, state)
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.transactions = [spec.Transaction(b'\x77' * 8)]
+    payload.block_hash = spec.Hash32(
+        spec.hash(payload.hash_tree_root() + b"FAKE RLP HASH")
+    )
+    yield from run_execution_payload_processing(spec, state, payload)
+    assert state.latest_execution_payload_header.transactions_root == (
+        spec.hash_tree_root(payload.transactions)
+    )

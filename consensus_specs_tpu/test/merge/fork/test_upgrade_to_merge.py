@@ -42,3 +42,58 @@ def test_upgrade_after_epochs(spec, state, phases):
     post = _upgrade(phases, state)
     assert list(post.previous_epoch_participation) == list(state.previous_epoch_participation)
     yield 'post', post
+
+
+def _randomize_pre_state(spec, state, seed):
+    from random import Random
+
+    rng = Random(seed)
+    for index in rng.sample(range(len(state.validators)), len(state.validators) // 4):
+        v = state.validators[index]
+        choice = rng.randrange(3)
+        if choice == 0:
+            v.slashed = True
+            v.withdrawable_epoch = spec.get_current_epoch(state) + 8
+        elif choice == 1:
+            v.exit_epoch = spec.get_current_epoch(state) + rng.randrange(1, 8)
+        state.balances[index] = spec.Gwei(rng.randrange(1, 2 * 10**9))
+        state.inactivity_scores[index] = spec.uint64(rng.randrange(0, 50))
+
+
+@with_phases([ALTAIR], other_phases=[MERGE])
+@spec_state_test
+def test_upgrade_random_registry(spec, state, phases):
+    next_epoch(spec, state)
+    _randomize_pre_state(spec, state, seed=31337)
+    yield 'pre', state
+    post = _upgrade(phases, state)
+    yield 'post', post
+    for pre_v, post_v in zip(state.validators, post.validators):
+        assert pre_v.pubkey == post_v.pubkey
+        assert pre_v.slashed == post_v.slashed
+        assert pre_v.effective_balance == post_v.effective_balance
+
+
+@with_phases([ALTAIR], other_phases=[MERGE])
+@spec_state_test
+def test_upgrade_random_registry_alt_seed(spec, state, phases):
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    _randomize_pre_state(spec, state, seed=271828)
+    yield 'pre', state
+    post = _upgrade(phases, state)
+    yield 'post', post
+
+
+@with_phases([ALTAIR], other_phases=[MERGE])
+@spec_state_test
+def test_upgrade_mid_epoch(spec, state, phases):
+    from ...helpers.state import next_slot
+
+    next_epoch(spec, state)
+    for _ in range(2):
+        next_slot(spec, state)
+    yield 'pre', state
+    post = _upgrade(phases, state)
+    yield 'post', post
+    assert post.latest_block_header == state.latest_block_header

@@ -272,3 +272,118 @@ def test_too_few_aggregation_bits(spec, state):
     )
 
     yield from run_attestation_processing(spec, state, attestation, valid=False)
+
+
+def _run_wrongness_delay_variant(spec, state, delay, wrong_head=False, wrong_target=False):
+    """Wrong-head/wrong-target attestations are processable at any legal
+    inclusion delay — wrongness only costs flags/rewards, not validity
+    (phase0 checks neither root; altair drops the matching flags)."""
+    attestation = get_valid_attestation(spec, state, signed=False)
+    if wrong_head:
+        attestation.data.beacon_block_root = b'\x42' * 32
+    if wrong_target:
+        attestation.data.target.root = b'\x42' * 32
+    sign_attestation(spec, state, attestation)
+    next_slots(spec, state, delay)
+    yield from run_attestation_processing(spec, state, attestation)
+
+
+def _sqrt_epoch(spec):
+    return int(spec.integer_squareroot(spec.uint64(int(spec.SLOTS_PER_EPOCH))))
+
+
+@with_all_phases
+@spec_state_test
+def test_correct_sqrt_epoch_delay(spec, state):
+    yield from _run_wrongness_delay_variant(spec, state, _sqrt_epoch(spec))
+
+
+@with_all_phases
+@spec_state_test
+def test_correct_epoch_delay(spec, state):
+    yield from _run_wrongness_delay_variant(spec, state, int(spec.SLOTS_PER_EPOCH))
+
+
+@with_all_phases
+@spec_state_test
+def test_incorrect_head_min_inclusion_delay(spec, state):
+    yield from _run_wrongness_delay_variant(
+        spec, state, int(spec.MIN_ATTESTATION_INCLUSION_DELAY), wrong_head=True,
+    )
+
+
+@with_all_phases
+@spec_state_test
+def test_incorrect_head_sqrt_epoch_delay(spec, state):
+    yield from _run_wrongness_delay_variant(
+        spec, state, _sqrt_epoch(spec), wrong_head=True,
+    )
+
+
+@with_all_phases
+@spec_state_test
+def test_incorrect_head_epoch_delay(spec, state):
+    yield from _run_wrongness_delay_variant(
+        spec, state, int(spec.SLOTS_PER_EPOCH), wrong_head=True,
+    )
+
+
+@with_all_phases
+@spec_state_test
+def test_incorrect_head_and_target_min_inclusion_delay(spec, state):
+    yield from _run_wrongness_delay_variant(
+        spec, state, int(spec.MIN_ATTESTATION_INCLUSION_DELAY),
+        wrong_head=True, wrong_target=True,
+    )
+
+
+@with_all_phases
+@spec_state_test
+def test_incorrect_head_and_target_sqrt_epoch_delay(spec, state):
+    yield from _run_wrongness_delay_variant(
+        spec, state, _sqrt_epoch(spec), wrong_head=True, wrong_target=True,
+    )
+
+
+@with_all_phases
+@spec_state_test
+def test_incorrect_head_and_target_epoch_delay(spec, state):
+    yield from _run_wrongness_delay_variant(
+        spec, state, int(spec.SLOTS_PER_EPOCH), wrong_head=True, wrong_target=True,
+    )
+
+
+@with_all_phases
+@spec_state_test
+def test_incorrect_target_min_inclusion_delay(spec, state):
+    yield from _run_wrongness_delay_variant(
+        spec, state, int(spec.MIN_ATTESTATION_INCLUSION_DELAY), wrong_target=True,
+    )
+
+
+@with_all_phases
+@spec_state_test
+def test_incorrect_target_sqrt_epoch_delay(spec, state):
+    yield from _run_wrongness_delay_variant(
+        spec, state, _sqrt_epoch(spec), wrong_target=True,
+    )
+
+
+@with_all_phases
+@spec_state_test
+def test_incorrect_target_epoch_delay(spec, state):
+    yield from _run_wrongness_delay_variant(
+        spec, state, int(spec.SLOTS_PER_EPOCH), wrong_target=True,
+    )
+
+
+@with_all_phases
+@spec_state_test
+def test_empty_participants_zeroed_sig(spec, state):
+    attestation = get_valid_attestation(
+        spec, state, filter_participant_set=lambda participants: set()
+    )
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    attestation.signature = spec.BLSSignature()
+    # zero participants: indexed attestation has no attesters -> invalid
+    yield from run_attestation_processing(spec, state, attestation, valid=False)

@@ -462,3 +462,166 @@ def test_full_operation_mix_in_one_block(spec, state):
     assert state.validators[ps_index].slashed
     assert state.validators[as_index].slashed
     assert state.validators[exit_index].exit_epoch < spec.FAR_FUTURE_EPOCH
+
+
+@with_all_phases
+@spec_state_test
+def test_skipped_slots_then_block(spec, state):
+    # several empty slots, then a block: ancestry roots must all point at
+    # the last actual block
+    yield 'pre', state
+    block = build_empty_block(spec, state, slot=state.slot + 4)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield 'blocks', [signed_block]
+    yield 'post', state
+    assert state.slot == block.slot
+    pre_root = block.parent_root
+    for slot in range(int(block.slot) - 4, int(block.slot)):
+        assert spec.get_block_root_at_slot(state, slot) == pre_root
+
+
+@with_all_phases
+@spec_state_test
+def test_empty_epoch_then_block(spec, state):
+    # a whole empty epoch before the next block
+    yield 'pre', state
+    block = build_empty_block(
+        spec, state, slot=state.slot + int(spec.SLOTS_PER_EPOCH) + 1
+    )
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield 'blocks', [signed_block]
+    yield 'post', state
+    assert spec.get_current_epoch(state) == 1
+
+
+@with_all_phases
+@spec_state_test
+def test_proposer_index_mismatch_rejected(spec, state):
+    block = build_empty_block_for_next_slot(spec, state)
+    active = spec.get_active_validator_indices(state, spec.get_current_epoch(state))
+    block.proposer_index = next(
+        i for i in active if i != block.proposer_index
+    )
+    yield 'pre', state
+    expect_assertion_error(
+        lambda: transition_unsigned_block(spec, state, block)
+    )
+    yield 'blocks', [spec.SignedBeaconBlock(message=block)]
+    yield 'post', None
+
+
+@with_all_phases
+@spec_state_test
+def test_wrong_parent_root_rejected(spec, state):
+    block = build_empty_block_for_next_slot(spec, state)
+    block.parent_root = b'\x58' * 32
+    yield 'pre', state
+    expect_assertion_error(
+        lambda: transition_unsigned_block(spec, state, block)
+    )
+    yield 'blocks', [spec.SignedBeaconBlock(message=block)]
+    yield 'post', None
+
+
+@with_all_phases
+@spec_state_test
+def test_wrong_state_root_rejected(spec, state):
+    block = build_empty_block_for_next_slot(spec, state)
+    block.state_root = b'\x44' * 32
+    signed_block = sign_block(spec, state, block)
+    yield 'pre', state
+    expect_assertion_error(
+        lambda: spec.state_transition(state, signed_block, True)
+    )
+    yield 'blocks', [signed_block]
+    yield 'post', None
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_block_signature_rejected(spec, state):
+    block = build_empty_block_for_next_slot(spec, state)
+    tmp = state.copy()
+    spec.process_slots(tmp, block.slot)
+    spec.process_block(tmp, block)
+    block.state_root = spec.hash_tree_root(tmp)
+    signed_block = spec.SignedBeaconBlock(
+        message=block, signature=spec.BLSSignature(b'\x0c' * 96)
+    )
+    yield 'pre', state
+    expect_assertion_error(
+        lambda: spec.state_transition(state, signed_block, True)
+    )
+    yield 'blocks', [signed_block]
+    yield 'post', None
+
+
+@with_all_phases
+@spec_state_test
+def test_double_same_proposer_slashings_rejected(spec, state):
+    # the same slashing twice in one block: second must fail (proposer
+    # already slashed)
+    slashing = get_valid_proposer_slashing(spec, state, signed_1=True, signed_2=True)
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.proposer_slashings = [slashing, slashing]
+    yield 'pre', state
+    expect_assertion_error(
+        lambda: transition_unsigned_block(spec, state, block)
+    )
+    yield 'blocks', [spec.SignedBeaconBlock(message=block)]
+    yield 'post', None
+
+
+@with_all_phases
+@spec_state_test
+def test_duplicate_attestation_in_block_allowed(spec, state):
+    # the same attestation included twice is wasteful but legal
+    next_epoch(spec, state)
+    next_slot(spec, state)
+    attestation = get_valid_attestation(spec, state, slot=state.slot - 1, signed=True)
+    yield 'pre', state
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.attestations = [attestation, attestation]
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield 'blocks', [signed_block]
+    yield 'post', state
+
+
+@with_all_phases
+@spec_state_test
+def test_exit_then_slash_in_sequence(spec, state):
+    # exit a validator via block N, slash it via block N+1 — both must land
+    for _ in range(int(spec.config.SHARD_COMMITTEE_PERIOD) + 1):
+        next_epoch(spec, state)
+    target = len(state.validators) - 2
+    exits = prepare_signed_exits(spec, state, [target])
+
+    yield 'pre', state
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.voluntary_exits = exits
+    signed_block_1 = state_transition_and_sign_block(spec, state, block)
+    assert state.validators[target].exit_epoch < spec.FAR_FUTURE_EPOCH
+
+    slashing = get_valid_attester_slashing(
+        spec, state, slot=state.slot - 1, signed_1=True, signed_2=True,
+    )
+    slashed_any = slashing.attestation_1.attesting_indices
+    block2 = build_empty_block_for_next_slot(spec, state)
+    block2.body.attester_slashings = [slashing]
+    signed_block_2 = state_transition_and_sign_block(spec, state, block2)
+    yield 'blocks', [signed_block_1, signed_block_2]
+    yield 'post', state
+    assert any(state.validators[i].slashed for i in slashed_any)
+
+
+@with_all_phases
+@spec_state_test
+def test_historical_batch_written_at_boundary(spec, state):
+    # place the state just under the historical-root horizon, then cross it:
+    # process_historical_roots_update must append a batch
+    limit = int(spec.SLOTS_PER_HISTORICAL_ROOT)
+    state.slot = spec.Slot(limit - 1)
+    assert len(state.historical_roots) == 0
+    next_epoch(spec, state)
+    assert len(state.historical_roots) > 0

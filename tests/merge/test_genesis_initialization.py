@@ -1,0 +1,1 @@
+from consensus_specs_tpu.test.merge.genesis.test_initialization import *  # noqa: F401,F403

@@ -1,0 +1,1 @@
+from consensus_specs_tpu.test.merge.fork_choice.test_on_merge_block import *  # noqa: F401,F403
