@@ -94,14 +94,3 @@ def test_rotation_tracks_registry_growth(spec, state):
     assert list(state.previous_epoch_participation) == pre_current
     assert len(state.current_epoch_participation) == grown
     assert all(int(f) == 0 for f in state.current_epoch_participation)
-
-
-@with_phases([ALTAIR])
-@spec_state_test
-def test_double_rotation_clears_everything(spec, state):
-    _randomize_flags(spec, state, Random(7))
-    n = len(state.validators)
-    spec.process_participation_flag_updates(state)
-    spec.process_participation_flag_updates(state)
-    assert list(state.previous_epoch_participation) == [spec.ParticipationFlags(0)] * n
-    assert list(state.current_epoch_participation) == [spec.ParticipationFlags(0)] * n

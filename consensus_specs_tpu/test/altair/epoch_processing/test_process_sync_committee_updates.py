@@ -39,40 +39,3 @@ def test_sync_committees_no_progress_mid_period(spec, state):
 
     assert state.current_sync_committee == pre_current
     assert state.next_sync_committee == pre_next
-
-
-@with_phases([ALTAIR])
-@with_presets([MINIMAL], reason="period transition needs few epochs only on minimal")
-@spec_state_test
-def test_full_period_walk_rotates_through_real_pipeline(spec, state):
-    # walk a whole sync-committee period through the REAL process_epoch
-    # (not the isolated pass): the lookahead committee must become current
-    # at the boundary, untouched by every mid-period transition
-    from ...helpers.state import next_epoch
-
-    period_epochs = int(spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD)
-    pre_next = state.next_sync_committee.copy()
-    for _ in range(period_epochs):
-        assert state.next_sync_committee == pre_next  # mid-period: untouched
-        next_epoch(spec, state)
-    assert state.current_sync_committee == pre_next
-    # a fresh lookahead was installed at the boundary (computed on the
-    # boundary state — recomputing here, one epoch later, would differ)
-    assert state.next_sync_committee != pre_next
-
-
-@with_phases([ALTAIR])
-@with_presets([MINIMAL], reason="period transition needs few epochs only on minimal")
-@spec_state_test
-def test_aggregate_pubkey_consistent_after_rotation(spec, state):
-    # the precomputed aggregate_pubkey matches the member pubkeys after the
-    # period rotation (altair/beacon-chain.md:279-293)
-    from ....utils import bls as bls_mod
-
-    period_epochs = int(spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD)
-    transition_to(spec, state, (period_epochs - 1) * spec.SLOTS_PER_EPOCH)
-    yield from run_epoch_processing_with(spec, state, 'process_sync_committee_updates')
-    committee = state.current_sync_committee
-    assert committee.aggregate_pubkey == spec.BLSPubkey(
-        bls_mod.AggregatePKs(list(committee.pubkeys))
-    )

@@ -5,6 +5,7 @@ from ...context import (
     ALTAIR, PHASE0, spec_state_test, with_phases,
 )
 from ...helpers.attestations import next_epoch_with_attestations
+from ...helpers.random import randomize_registry_for_upgrade
 from ...helpers.state import next_epoch
 
 
@@ -73,30 +74,11 @@ def test_upgrade_translates_participation(spec, state, phases):
     yield 'post', post
 
 
-def _randomize_pre_state(spec, state, seed):
-    from random import Random
-
-    rng = Random(seed)
-    for index in rng.sample(range(len(state.validators)), len(state.validators) // 4):
-        v = state.validators[index]
-        choice = rng.randrange(4)
-        if choice == 0:
-            v.slashed = True
-            v.exit_epoch = spec.get_current_epoch(state)
-            v.withdrawable_epoch = spec.get_current_epoch(state) + 16
-        elif choice == 1:
-            v.exit_epoch = spec.get_current_epoch(state) + rng.randrange(1, 8)
-        elif choice == 2:
-            v.activation_epoch = spec.FAR_FUTURE_EPOCH
-            v.activation_eligibility_epoch = spec.get_current_epoch(state) + 1
-        state.balances[index] = spec.Gwei(rng.randrange(1, 2 * 10**9))
-
-
 @with_phases([PHASE0], other_phases=[ALTAIR])
 @spec_state_test
 def test_upgrade_random_registry_low(spec, state, phases):
     next_epoch(spec, state)
-    _randomize_pre_state(spec, state, seed=101)
+    randomize_registry_for_upgrade(spec, state, seed=101, include_activation=True)
     yield 'pre', state
     post = _upgrade(phases, state)
     yield 'post', post
@@ -112,7 +94,7 @@ def test_upgrade_random_registry_low(spec, state, phases):
 def test_upgrade_random_registry_alt_seed(spec, state, phases):
     next_epoch(spec, state)
     next_epoch(spec, state)
-    _randomize_pre_state(spec, state, seed=202)
+    randomize_registry_for_upgrade(spec, state, seed=202, include_activation=True)
     yield 'pre', state
     post = _upgrade(phases, state)
     yield 'post', post

@@ -121,16 +121,3 @@ def test_empty_sync_aggregate_accepted(spec, state):
     signed_block = state_transition_and_sign_block(spec, state, block)
     yield 'blocks', [signed_block]
     yield 'post', state
-
-
-@with_phases([ALTAIR])
-@spec_state_test
-def test_inactivity_scores_grow_through_empty_leak_epochs(spec, state):
-    from ...helpers.state import next_epoch
-
-    # no attestations for > MIN_EPOCHS_TO_INACTIVITY_PENALTY: the leak arms
-    # and scores climb for everyone
-    for _ in range(int(spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY) + 3):
-        next_epoch(spec, state)
-    assert spec.is_in_inactivity_leak(state)
-    assert all(int(s) > 0 for s in state.inactivity_scores)

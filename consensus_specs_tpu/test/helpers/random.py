@@ -150,3 +150,26 @@ def _next_proposer_slashed(spec, state) -> bool:
     tmp = state.copy()
     spec.process_slots(tmp, tmp.slot + 1)
     return bool(tmp.validators[spec.get_beacon_proposer_index(tmp)].slashed)
+
+
+def randomize_registry_for_upgrade(spec, state, seed, include_activation=False):
+    """Perturb a quarter of the registry (slashings, exits, balances — and
+    optionally pending activations) ahead of a fork-upgrade test."""
+    from random import Random
+
+    rng = Random(seed)
+    for index in rng.sample(range(len(state.validators)), len(state.validators) // 4):
+        v = state.validators[index]
+        choice = rng.randrange(4 if include_activation else 3)
+        if choice == 0:
+            v.slashed = True
+            v.exit_epoch = spec.get_current_epoch(state)
+            v.withdrawable_epoch = spec.get_current_epoch(state) + 16
+        elif choice == 1:
+            v.exit_epoch = spec.get_current_epoch(state) + rng.randrange(1, 8)
+        elif choice == 3:
+            v.activation_epoch = spec.FAR_FUTURE_EPOCH
+            v.activation_eligibility_epoch = spec.get_current_epoch(state) + 1
+        state.balances[index] = spec.Gwei(rng.randrange(1, 2 * 10**9))
+        if hasattr(state, 'inactivity_scores'):
+            state.inactivity_scores[index] = spec.uint64(rng.randrange(0, 50))

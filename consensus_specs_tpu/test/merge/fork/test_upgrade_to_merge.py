@@ -1,6 +1,7 @@
 """upgrade_to_merge fork-transition tests
 (spec: reference specs/merge/fork.md:30-85)."""
 from ...context import ALTAIR, MERGE, spec_state_test, with_phases
+from ...helpers.random import randomize_registry_for_upgrade
 from ...helpers.state import next_epoch
 
 
@@ -44,27 +45,11 @@ def test_upgrade_after_epochs(spec, state, phases):
     yield 'post', post
 
 
-def _randomize_pre_state(spec, state, seed):
-    from random import Random
-
-    rng = Random(seed)
-    for index in rng.sample(range(len(state.validators)), len(state.validators) // 4):
-        v = state.validators[index]
-        choice = rng.randrange(3)
-        if choice == 0:
-            v.slashed = True
-            v.withdrawable_epoch = spec.get_current_epoch(state) + 8
-        elif choice == 1:
-            v.exit_epoch = spec.get_current_epoch(state) + rng.randrange(1, 8)
-        state.balances[index] = spec.Gwei(rng.randrange(1, 2 * 10**9))
-        state.inactivity_scores[index] = spec.uint64(rng.randrange(0, 50))
-
-
 @with_phases([ALTAIR], other_phases=[MERGE])
 @spec_state_test
 def test_upgrade_random_registry(spec, state, phases):
     next_epoch(spec, state)
-    _randomize_pre_state(spec, state, seed=31337)
+    randomize_registry_for_upgrade(spec, state, seed=31337)
     yield 'pre', state
     post = _upgrade(phases, state)
     yield 'post', post
@@ -79,7 +64,7 @@ def test_upgrade_random_registry(spec, state, phases):
 def test_upgrade_random_registry_alt_seed(spec, state, phases):
     next_epoch(spec, state)
     next_epoch(spec, state)
-    _randomize_pre_state(spec, state, seed=271828)
+    randomize_registry_for_upgrade(spec, state, seed=271828)
     yield 'pre', state
     post = _upgrade(phases, state)
     yield 'post', post

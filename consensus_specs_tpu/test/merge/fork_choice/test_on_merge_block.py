@@ -10,9 +10,11 @@ from ...helpers.execution_payload import (
 from ...helpers.fork_choice import (
     get_genesis_forkchoice_store_and_block,
     run_on_block,
-    slot_time,
     tick_to_slot,
 )
+
+# the last case's store/block, for post-drive assertions in yielding tests
+_LAST_CASE = {}
 
 
 class _PowChain:
@@ -71,8 +73,12 @@ def _merge_block_on_pow_head(spec, state, pow_head):
 
 
 def _run_merge_block_case(spec, state, pow_blocks, valid=True, pow_head=None):
+    """Drives the handler AND emits a fork_choice-format vector case
+    (anchor_state/anchor_block/steps, tests/formats/fork_choice)."""
     build_state_with_incomplete_transition(spec, state)
     store, anchor = get_genesis_forkchoice_store_and_block(spec, state)
+    yield 'anchor_state', state
+    yield 'anchor_block', anchor
     test_steps = []
     block = _merge_block_on_pow_head(spec, state, pow_head)
     tick_to_slot(spec, store, block.slot, test_steps)
@@ -85,17 +91,20 @@ def _run_merge_block_case(spec, state, pow_blocks, valid=True, pow_head=None):
         block.state_root = spec.hash_tree_root(post)
         signed = sign_block(spec, state, block)
         run_on_block(spec, store, signed, valid=valid)
-    return store, block
+        test_steps.append({'block': f'on_merge_block_{int(block.slot)}', 'valid': valid})
+    yield 'steps', 'data', test_steps
+    _LAST_CASE.clear()
+    _LAST_CASE.update(store=store, block=block)
 
 
 @with_phases([MERGE])
 @spec_state_test
 def test_merge_block_terminal_crossing_accepted(spec, state):
     parent, head = _terminal_pow_chain(spec, crossed=True, parent_crossed=False)
-    store, block = _run_merge_block_case(
+    yield from _run_merge_block_case(
         spec, state, [parent, head], valid=True, pow_head=head,
     )
-    assert spec.hash_tree_root(block) in store.blocks
+    assert spec.hash_tree_root(_LAST_CASE['block']) in _LAST_CASE['store'].blocks
 
 
 @with_phases([MERGE])
@@ -103,21 +112,21 @@ def test_merge_block_terminal_crossing_accepted(spec, state):
 def test_merge_block_pow_block_missing(spec, state):
     # the payload's parent is not in the PoW chain view at all
     parent, head = _terminal_pow_chain(spec, crossed=True)
-    _run_merge_block_case(spec, state, [parent], valid=False, pow_head=head)
+    yield from _run_merge_block_case(spec, state, [parent], valid=False, pow_head=head)
 
 
 @with_phases([MERGE])
 @spec_state_test
 def test_merge_block_pow_parent_missing(spec, state):
     parent, head = _terminal_pow_chain(spec, crossed=True)
-    _run_merge_block_case(spec, state, [head], valid=False, pow_head=head)
+    yield from _run_merge_block_case(spec, state, [head], valid=False, pow_head=head)
 
 
 @with_phases([MERGE])
 @spec_state_test
 def test_merge_block_ttd_not_reached(spec, state):
     parent, head = _terminal_pow_chain(spec, crossed=False)
-    _run_merge_block_case(spec, state, [parent, head], valid=False, pow_head=head)
+    yield from _run_merge_block_case(spec, state, [parent, head], valid=False, pow_head=head)
 
 
 @with_phases([MERGE])
@@ -125,4 +134,4 @@ def test_merge_block_ttd_not_reached(spec, state):
 def test_merge_block_parent_already_crossed(spec, state):
     # not the crossing block: the parent already met the TTD
     parent, head = _terminal_pow_chain(spec, crossed=True, parent_crossed=True)
-    _run_merge_block_case(spec, state, [parent, head], valid=False, pow_head=head)
+    yield from _run_merge_block_case(spec, state, [parent, head], valid=False, pow_head=head)

@@ -18,6 +18,8 @@ def _genesis_inputs(spec):
 @spec_test
 def test_initialize_pre_transition(spec):
     eth1_block_hash, eth1_timestamp, deposits = _genesis_inputs(spec)
+    yield 'eth1_block_hash', 'bytes', eth1_block_hash
+    yield 'eth1_timestamp', 'meta', int(eth1_timestamp)
     state = spec.initialize_beacon_state_from_eth1(
         eth1_block_hash, eth1_timestamp, deposits
     )
@@ -40,6 +42,9 @@ def test_initialize_post_transition(spec):
         gas_limit=spec.uint64(30_000_000),
         block_number=spec.uint64(1),
     )
+    yield 'eth1_block_hash', 'bytes', eth1_block_hash
+    yield 'eth1_timestamp', 'meta', int(eth1_timestamp)
+    yield 'execution_payload_header', header
     state = spec.initialize_beacon_state_from_eth1(
         eth1_block_hash, eth1_timestamp, deposits,
         execution_payload_header=header,
@@ -54,9 +59,12 @@ def test_initialize_post_transition(spec):
 @spec_test
 def test_initialize_sync_committees_filled(spec):
     eth1_block_hash, eth1_timestamp, deposits = _genesis_inputs(spec)
+    yield 'eth1_block_hash', 'bytes', eth1_block_hash
+    yield 'eth1_timestamp', 'meta', int(eth1_timestamp)
     state = spec.initialize_beacon_state_from_eth1(
         eth1_block_hash, eth1_timestamp, deposits
     )
     # altair machinery carried through the merge genesis
     assert state.current_sync_committee == spec.get_next_sync_committee(state)
     assert len(state.inactivity_scores) == len(state.validators)
+    yield 'state', state

@@ -181,3 +181,65 @@ def test_ejection(spec, state):
         state.validators[index],
         spec.compute_activation_exit_epoch(spec.get_current_epoch(state))
     )
+
+
+@with_all_phases
+@spec_state_test
+def test_ejection_past_churn_limit(spec, state):
+    # more ejections than the churn limit: exit epochs spread across epochs
+    churn_limit = int(spec.get_validator_churn_limit(state))
+    count = churn_limit * 2 + 1
+    for i in range(count):
+        state.validators[i].effective_balance = spec.config.EJECTION_BALANCE
+
+    yield from run_process_registry_updates(spec, state)
+
+    exit_epochs = sorted(
+        int(state.validators[i].exit_epoch) for i in range(count)
+    )
+    assert exit_epochs[-1] > exit_epochs[0]
+    # no epoch takes more than the churn limit
+    from collections import Counter
+    for epoch, n in Counter(exit_epochs).items():
+        assert n <= churn_limit
+
+
+@with_all_phases
+@spec_state_test
+def test_activation_and_ejection_in_one_pass(spec, state):
+    # one validator enters the queue while another is ejected, same epoch
+    mock_deposit(spec, state, 1)
+    state.validators[2].effective_balance = spec.config.EJECTION_BALANCE
+
+    yield from run_process_registry_updates(spec, state)
+
+    assert state.validators[1].activation_eligibility_epoch != spec.FAR_FUTURE_EPOCH
+    assert state.validators[2].exit_epoch != spec.FAR_FUTURE_EPOCH
+
+
+@with_all_phases
+@spec_state_test
+def test_no_eligibility_without_full_balance(spec, state):
+    # a mocked deposit below MAX_EFFECTIVE_BALANCE stays out of the queue
+    mock_deposit(spec, state, 3)
+    state.validators[3].effective_balance = (
+        spec.MAX_EFFECTIVE_BALANCE - spec.EFFECTIVE_BALANCE_INCREMENT
+    )
+
+    yield from run_process_registry_updates(spec, state)
+
+    assert state.validators[3].activation_eligibility_epoch == spec.FAR_FUTURE_EPOCH
+
+
+@with_all_phases
+@spec_state_test
+def test_already_exited_not_ejected_again(spec, state):
+    index = 4
+    exit_epoch = spec.get_current_epoch(state) + 5
+    state.validators[index].exit_epoch = exit_epoch
+    state.validators[index].effective_balance = spec.config.EJECTION_BALANCE
+
+    yield from run_process_registry_updates(spec, state)
+
+    # initiate_validator_exit is a no-op for an already-exiting validator
+    assert state.validators[index].exit_epoch == exit_epoch

@@ -128,3 +128,114 @@ def test_leak_with_half_participation(spec, state):
 
     prepare_rewards_state(spec, state)
     yield from run_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_quarter_attestations(spec, state):
+    def quarter(slot, index, committee):
+        members = sorted(committee)
+        return set(members[: max(1, len(members) // 4)])
+
+    state = _attested_state(spec, state, participation_fn=quarter)
+    yield from run_deltas_at_boundary(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_one_attester_per_committee(spec, state):
+    def lone(slot, index, committee):
+        return {sorted(committee)[0]}
+
+    state = _attested_state(spec, state, participation_fn=lone)
+    yield from run_deltas_at_boundary(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_random_attestations_alt_seed(spec, state):
+    rng = Random(987654)
+
+    def sample(slot, index, committee):
+        picked = {m for m in committee if rng.randrange(3) == 0}
+        return picked or {sorted(committee)[0]}
+
+    state = _attested_state(spec, state, participation_fn=sample)
+    yield from run_deltas_at_boundary(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_exited_validators_no_deltas(spec, state):
+    # exit validators BEFORE the attested epoch so committee composition is
+    # consistent with the recorded attestations
+    next_epoch(spec, state)
+    for index in (1, 3):
+        v = state.validators[index]
+        v.exit_epoch = spec.get_current_epoch(state) + 1
+        v.withdrawable_epoch = v.exit_epoch + 1
+    next_epoch(spec, state)
+    _, _, post = next_epoch_with_attestations(spec, state, True, False)
+    state = post
+    yield from run_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_some_slashed_some_exited(spec, state):
+    next_epoch(spec, state)
+    v = state.validators[2]
+    v.exit_epoch = spec.get_current_epoch(state) + 1
+    v.withdrawable_epoch = v.exit_epoch + 8
+    next_epoch(spec, state)
+    _, _, post = next_epoch_with_attestations(spec, state, True, False)
+    state = post
+    # slash AFTER the attested epoch: committees stay consistent and the
+    # slashed-but-not-withdrawable validator remains eligible for penalties
+    state.validators[0].slashed = True
+    state.validators[0].withdrawable_epoch = spec.get_current_epoch(state) + 16
+    yield from run_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_deep_leak_escalating_penalties(spec, state):
+    # far into a leak, the inactivity penalties dominate
+    for _ in range(int(spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY) + 5):
+        next_epoch(spec, state)
+    assert spec.is_in_inactivity_leak(state)
+    yield from run_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_leak_with_sparse_participation(spec, state):
+    def sparse(slot, index, committee):
+        members = sorted(committee)
+        return set(members[: max(1, len(members) // 8)])
+
+    next_epoch(spec, state)
+    state, _, post = next_epoch_with_attestations(
+        spec, state, True, False, participation_fn=sparse
+    )
+    state = post
+    for _ in range(int(spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY) + 2):
+        next_epoch(spec, state)
+    if not spec.is_in_inactivity_leak(state):
+        import pytest
+        pytest.skip("state finalized despite sparse participation")
+    yield from run_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_uneven_effective_balances(spec, state):
+    state = _attested_state(spec, state)
+    # shake up effective balances across the valid increments
+    for i, v in enumerate(state.validators):
+        steps = (i % 5)
+        v.effective_balance = spec.Gwei(
+            int(spec.MAX_EFFECTIVE_BALANCE)
+            - steps * int(spec.EFFECTIVE_BALANCE_INCREMENT) // 2
+        ) // int(spec.EFFECTIVE_BALANCE_INCREMENT) * int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    yield from run_deltas(spec, state)

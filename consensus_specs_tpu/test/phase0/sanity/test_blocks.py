@@ -613,15 +613,3 @@ def test_exit_then_slash_in_sequence(spec, state):
     yield 'blocks', [signed_block_1, signed_block_2]
     yield 'post', state
     assert any(state.validators[i].slashed for i in slashed_any)
-
-
-@with_all_phases
-@spec_state_test
-def test_historical_batch_written_at_boundary(spec, state):
-    # place the state just under the historical-root horizon, then cross it:
-    # process_historical_roots_update must append a batch
-    limit = int(spec.SLOTS_PER_HISTORICAL_ROOT)
-    state.slot = spec.Slot(limit - 1)
-    assert len(state.historical_roots) == 0
-    next_epoch(spec, state)
-    assert len(state.historical_roots) > 0

@@ -1,0 +1,35 @@
+"""Epoch-machinery unit checks, pytest-only (not vector-format cases)."""
+from ...context import spec_state_test, with_all_phases
+from ...helpers.state import next_epoch
+
+
+def mock_deposit(spec, state, index):
+    state.validators[index].activation_eligibility_epoch = spec.FAR_FUTURE_EPOCH
+    state.validators[index].activation_epoch = spec.FAR_FUTURE_EPOCH
+    state.validators[index].effective_balance = spec.MAX_EFFECTIVE_BALANCE
+
+
+@with_all_phases
+@spec_state_test
+def test_historical_batch_written_at_boundary(spec, state):
+    # place the state just under the historical-root horizon, then cross it:
+    # process_historical_roots_update must append a batch
+    limit = int(spec.SLOTS_PER_HISTORICAL_ROOT)
+    state.slot = spec.Slot(limit - 1)
+    assert len(state.historical_roots) == 0
+    next_epoch(spec, state)
+    assert len(state.historical_roots) > 0
+
+
+@with_all_phases
+@spec_state_test
+def test_activation_epoch_respects_exit_lookahead(spec, state):
+    # freshly finalized eligibility activates with the standard lookahead
+    mock_deposit(spec, state, 5)
+    state.validators[5].activation_eligibility_epoch = spec.get_current_epoch(state)
+    state.finalized_checkpoint.epoch = spec.get_current_epoch(state)
+    # run the pass directly (run_epoch_processing_with advances an epoch and
+    # would shift the arithmetic)
+    current = spec.get_current_epoch(state)
+    spec.process_registry_updates(state)
+    assert state.validators[5].activation_epoch >= spec.compute_activation_exit_epoch(current)

@@ -166,3 +166,123 @@ def test_get_eth1_vote_default_and_majority(spec, state):
         if spec.is_candidate_block(b, period_start)
     ]
     assert vote == candidates[-1]
+
+
+@with_all_phases
+@spec_state_test
+def test_is_candidate_block_window(spec, state):
+    follow = int(spec.config.SECONDS_PER_ETH1_BLOCK) * int(spec.config.ETH1_FOLLOW_DISTANCE)
+    # a nonzero genesis time so the lookback window doesn't clamp at zero
+    state.genesis_time = spec.uint64(10 * follow)
+    period_start = spec.voting_period_start_time(state)
+    assert int(period_start) >= 2 * follow
+
+    def block_at(ts):
+        return spec.Eth1Block(timestamp=spec.uint64(max(0, ts)),
+                              deposit_count=1, deposit_root=b'\x22' * 32)
+
+    # inside the [2*follow, follow] lookback window
+    assert spec.is_candidate_block(block_at(int(period_start) - follow), period_start)
+    assert spec.is_candidate_block(block_at(int(period_start) - 2 * follow), period_start)
+    # too recent / too old
+    assert not spec.is_candidate_block(block_at(int(period_start) - follow + 1), period_start)
+    assert not spec.is_candidate_block(block_at(int(period_start) - 2 * follow - 1), period_start)
+
+
+@with_all_phases
+@spec_state_test
+def test_compute_new_state_root_matches_transition(spec, state):
+    block = build_empty_block(spec, state, slot=state.slot + 1)
+    root = spec.compute_new_state_root(state, block)
+    post = state.copy()
+    spec.process_slots(post, block.slot)
+    spec.process_block(post, block)
+    assert root == spec.hash_tree_root(post)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_get_block_signature_verifies(spec, state):
+    block = build_empty_block(spec, state, slot=state.slot + 1)
+    tmp = state.copy()
+    spec.process_slots(tmp, block.slot)
+    proposer_index = spec.get_beacon_proposer_index(tmp)
+    signature = spec.get_block_signature(state, block, privkeys[proposer_index])
+    domain = spec.get_domain(
+        state, spec.DOMAIN_BEACON_PROPOSER, spec.compute_epoch_at_slot(block.slot)
+    )
+    signing_root = spec.compute_signing_root(block, domain)
+    assert spec.bls.Verify(pubkeys[proposer_index], signing_root, signature)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_get_slot_signature_verifies(spec, state):
+    slot = state.slot
+    signature = spec.get_slot_signature(state, slot, privkeys[7])
+    domain = spec.get_domain(
+        state, spec.DOMAIN_SELECTION_PROOF, spec.compute_epoch_at_slot(slot)
+    )
+    signing_root = spec.compute_signing_root(slot, domain)
+    assert spec.bls.Verify(pubkeys[7], signing_root, signature)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_get_attestation_signature_verifies(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=False)
+    participant = spec.get_beacon_committee(
+        state, attestation.data.slot, attestation.data.index
+    )[0]
+    signature = spec.get_attestation_signature(
+        state, attestation.data, privkeys[participant]
+    )
+    domain = spec.get_domain(
+        state, spec.DOMAIN_BEACON_ATTESTER, attestation.data.target.epoch
+    )
+    signing_root = spec.compute_signing_root(attestation.data, domain)
+    assert spec.bls.Verify(pubkeys[participant], signing_root, signature)
+
+
+@with_all_phases
+@spec_state_test
+def test_compute_fork_digest_distinct_per_version(spec, state):
+    digest_a = spec.compute_fork_digest(
+        spec.Version(b'\x00\x00\x00\x00'), state.genesis_validators_root
+    )
+    digest_b = spec.compute_fork_digest(
+        spec.Version(b'\x01\x00\x00\x00'), state.genesis_validators_root
+    )
+    assert digest_a != digest_b
+    # deterministic
+    assert digest_a == spec.compute_fork_digest(
+        spec.Version(b'\x00\x00\x00\x00'), state.genesis_validators_root
+    )
+
+
+@with_all_phases
+@spec_state_test
+def test_get_committee_assignment_out_of_bound_epoch(spec, state):
+    from ...context import expect_assertion_error
+
+    epoch = spec.get_current_epoch(state) + 2  # beyond the 1-epoch lookahead
+    expect_assertion_error(
+        lambda: spec.get_committee_assignment(state, epoch, spec.ValidatorIndex(0))
+    )
+
+
+@with_all_phases
+@spec_state_test
+def test_eth1_vote_ignores_noncandidate_chain(spec, state):
+    period_start = spec.voting_period_start_time(state)
+    follow = int(spec.config.SECONDS_PER_ETH1_BLOCK) * int(spec.config.ETH1_FOLLOW_DISTANCE)
+    # every block too recent: default vote (state.eth1_data)
+    chain = [
+        spec.Eth1Block(timestamp=spec.uint64(int(period_start)),
+                       deposit_count=5, deposit_root=b'\x01' * 32)
+    ]
+    vote = spec.get_eth1_vote(state, chain)
+    assert vote == state.eth1_data

@@ -1,0 +1,1 @@
+from consensus_specs_tpu.test.phase0.unittests.test_epoch_machinery import *  # noqa: F401,F403

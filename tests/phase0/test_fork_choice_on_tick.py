@@ -1,0 +1,1 @@
+from consensus_specs_tpu.test.phase0.unittests.fork_choice.test_on_tick import *  # noqa: F401,F403
