@@ -1,0 +1,12 @@
+#!/bin/bash
+# Retry bench configs against the intermittent axon tunnel; append every
+# emitted JSON line (TPU or fallback) to the results log. Meant to run in
+# the background during a build session; safe to kill any time.
+OUT=${1:-/tmp/tpu_harvest.jsonl}
+ATTEMPTS=${2:-6}
+for i in $(seq 1 "$ATTEMPTS"); do
+  echo "=== attempt $i committee $(date -u +%H:%M:%S) ===" >> "$OUT"
+  BENCH_N=64 BENCH_K=128 BENCH_PROBE_TIMEOUT=420 timeout 500 python bench.py >> "$OUT" 2>/dev/null
+  echo "=== attempt $i epoch $(date -u +%H:%M:%S) ===" >> "$OUT"
+  BENCH_MODE=epoch BENCH_PROBE_TIMEOUT=900 timeout 980 python bench.py >> "$OUT" 2>/dev/null
+done

@@ -11,6 +11,12 @@ def arm(seconds=540):
     faulthandler.dump_traceback_later(seconds, exit=True, file=log)
 arm()
 t0=time.time(); import jax; p('import jax %.1fs' % (time.time()-t0))
+# mirror the env var into the live config: a bare env JAX_PLATFORMS=cpu
+# does NOT stop jax from initializing every registered platform (the axon
+# tunnel included) on the first device op — TPU_NOTES.md failure mode 4
+_plat = os.environ.get('JAX_PLATFORMS')
+if _plat:
+    jax.config.update('jax_platforms', _plat)
 t0=time.time()
 try:
     d = jax.devices()
@@ -54,4 +60,41 @@ try:
     p('mont_mul OK %.1fs match=%s' % (time.time()-t0, got == want))
 except Exception as e:
     p('mont_mul FAILED %.1fs: %r' % (time.time()-t0, repr(e)[:400]))
+# u64-vs-u32 representation shoot-out (SURVEY risk #1): batched mont_mul
+# throughput of the production 15x28-bit/u64 path against the fq32
+# 32x12-bit/u32 fallback, on whatever device granted
+arm()
+try:
+    from consensus_specs_tpu.ops import fq32
+    import numpy as np
+
+    def bench_rep(mod, tag, batch=4096, iters=32):
+        xs = [(i * 0x9E3779B97F4A7C15 + 1) % mod.P for i in range(batch)]
+        a = np.stack([mod.to_mont_int(x) for x in xs])
+        b = np.stack([mod.to_mont_int((x * 7 + 3) % mod.P) for x in xs])
+        da, db = jax.device_put(a), jax.device_put(b)
+        f = jax.jit(lambda u, v: mod.mont_mul(u, v))
+        t0 = time.time(); f(da, db).block_until_ready()
+        compile_s = time.time() - t0
+        t0 = time.time()
+        out = da
+        for _ in range(iters):
+            out = f(out, db)
+        out.block_until_ready()
+        dt = time.time() - t0
+        rate = batch * iters / dt
+        # correctness of the chained product on one lane
+        got = mod.from_mont_limbs(np.asarray(out)[0])
+        want = xs[0]
+        for _ in range(iters):
+            want = want * ((xs[0] * 7 + 3) % mod.P) % mod.P
+        p('%s mont_mul %.0f mul/s (compile %.1fs, run %.2fs) match=%s'
+          % (tag, rate, compile_s, dt, got == want))
+        return rate
+
+    r64 = bench_rep(fq, 'fq_u64')
+    r32 = bench_rep(fq32, 'fq32_u32')
+    p('representation ratio u32/u64 = %.2fx' % (r32 / r64))
+except Exception as e:
+    p('rep shootout FAILED: %r' % (repr(e)[:400]))
 p('=== probe end', time.strftime('%H:%M:%S'))
