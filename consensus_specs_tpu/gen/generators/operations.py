@@ -32,6 +32,7 @@ SHARDING_MODS = {
     "attested_shard_work": f"{_T}.sharding.block_processing.test_process_attested_shard_work",
 }
 CUSTODY_GAME_MODS = combine_mods(SHARDING_MODS, {
+    "attestation": f"{_T}.custody_game.block_processing.test_process_attestation",
     "custody_key_reveal": f"{_T}.custody_game.block_processing.test_process_custody_key_reveal",
     "early_derived_secret_reveal": f"{_T}.custody_game.block_processing.test_process_early_derived_secret_reveal",
     "chunk_challenge": f"{_T}.custody_game.block_processing.test_process_chunk_challenge",

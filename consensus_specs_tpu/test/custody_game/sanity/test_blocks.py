@@ -68,3 +68,27 @@ def test_empty_block_keeps_custody_state(spec, state):
     yield 'post', state
     assert state.custody_chunk_challenge_index == 0
     assert not any(v.slashed for v in state.validators)
+
+
+@with_phases([CUSTODY_GAME])
+@spec_state_test
+def test_block_with_custody_key_reveal(spec, state):
+    from ...helpers.custody_game import get_valid_custody_key_reveal
+    from ...helpers.state import transition_to
+
+    # one custody period must elapse before the first reveal is due; the
+    # walk stays short of the deadline epoch so no one gets slashed
+    transition_to(
+        spec, state,
+        state.slot + int(spec.EPOCHS_PER_CUSTODY_PERIOD) * int(spec.SLOTS_PER_EPOCH),
+    )
+    reveal = get_valid_custody_key_reveal(spec, state)
+
+    yield 'pre', state
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.custody_key_reveals = [reveal]
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield 'blocks', [signed_block]
+    yield 'post', state
+
+    assert state.validators[reveal.revealer_index].next_custody_secret_to_reveal == 1

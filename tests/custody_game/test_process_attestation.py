@@ -1,0 +1,1 @@
+from consensus_specs_tpu.test.custody_game.block_processing.test_process_attestation import *  # noqa: F401,F403

@@ -4,9 +4,10 @@
 # the background during a build session; safe to kill any time.
 OUT=${1:-/tmp/tpu_harvest.jsonl}
 ATTEMPTS=${2:-6}
+cd "$(dirname "$0")/.." || exit 1
 for i in $(seq 1 "$ATTEMPTS"); do
   echo "=== attempt $i committee $(date -u +%H:%M:%S) ===" >> "$OUT"
-  BENCH_N=64 BENCH_K=128 BENCH_PROBE_TIMEOUT=420 timeout 500 python bench.py >> "$OUT" 2>/dev/null
+  BENCH_N=64 BENCH_K=128 BENCH_PROBE_TIMEOUT=420 timeout 560 python bench.py >> "$OUT" 2>> "$OUT"
   echo "=== attempt $i epoch $(date -u +%H:%M:%S) ===" >> "$OUT"
-  BENCH_MODE=epoch BENCH_PROBE_TIMEOUT=900 timeout 980 python bench.py >> "$OUT" 2>/dev/null
+  BENCH_MODE=epoch BENCH_PROBE_TIMEOUT=900 timeout 1100 python bench.py >> "$OUT" 2>> "$OUT"
 done
