@@ -55,6 +55,7 @@ SOURCES = {
     ],
     "sharding": [
         "beacon_chain.py",
+        "p2p.py",
     ],
     "custody_game": [
         "beacon_chain.py",

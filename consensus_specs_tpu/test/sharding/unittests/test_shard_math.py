@@ -134,3 +134,46 @@ def test_shard_proposer_is_active_validator(spec, state):
 def test_participation_flags_extended(spec, state):
     assert len(spec.PARTICIPATION_FLAG_WEIGHTS) == 4
     assert spec.PARTICIPATION_FLAG_WEIGHTS[spec.TIMELY_SHARD_FLAG_INDEX] == spec.TIMELY_SHARD_WEIGHT
+
+
+@with_phases([SHARDING, CUSTODY_GAME])
+@spec_state_test
+def test_shard_blob_subnet_in_range_and_distinct(spec, state):
+    # (reference specs/sharding/p2p-interface.md:67-78)
+    next_epoch(spec, state)
+    epoch = spec.get_current_epoch(state)
+    committees = int(spec.get_committee_count_per_slot(state, epoch))
+    start = spec.compute_start_slot_at_epoch(epoch)
+    seen = set()
+    for slot in range(int(start), int(start) + int(spec.SLOTS_PER_EPOCH)):
+        start_shard = int(spec.get_start_shard(state, spec.Slot(slot)))
+        active = int(spec.get_active_shard_count(state, epoch))
+        for i in range(committees):
+            shard = spec.Shard((start_shard + i) % active)
+            subnet = spec.compute_subnet_for_shard_blob(state, spec.Slot(slot), shard)
+            assert 0 <= int(subnet) < spec.SHARD_BLOB_SUBNET_COUNT
+            seen.add((slot, int(shard), int(subnet)))
+    # each (slot, shard) of the epoch has a deterministic subnet; with
+    # committees*slots <= subnet count the mapping is collision-free
+    if committees * int(spec.SLOTS_PER_EPOCH) <= int(spec.SHARD_BLOB_SUBNET_COUNT):
+        assert len({sub for (_, _, sub) in seen}) == len(seen)
+
+
+@with_phases([SHARDING, CUSTODY_GAME])
+@spec_state_test
+def test_shard_blob_subnet_rejects_uncovered_shard(spec, state):
+    next_epoch(spec, state)
+    epoch = spec.get_current_epoch(state)
+    committees = int(spec.get_committee_count_per_slot(state, epoch))
+    active = int(spec.get_active_shard_count(state, epoch))
+    if committees >= active:
+        import pytest
+        pytest.skip("every shard has a committee in this configuration")
+    slot = state.slot
+    uncovered = spec.Shard((int(spec.get_start_shard(state, slot)) + committees) % active)
+    try:
+        spec.compute_subnet_for_shard_blob(state, slot, uncovered)
+        raised = False
+    except AssertionError:
+        raised = True
+    assert raised
