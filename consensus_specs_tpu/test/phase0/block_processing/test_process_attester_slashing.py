@@ -162,3 +162,159 @@ def test_unsorted_att_1(spec, state):
     sign_indexed_attestation(spec, state, attester_slashing.attestation_1)
 
     yield from run_attester_slashing_processing(spec, state, attester_slashing, valid=False)
+
+
+def _mutate_indices(spec, state, attester_slashing, which, mutate, resign=True):
+    """Apply ``mutate`` to attestation_{which}'s attesting_indices; re-sign
+    unless testing the stale-signature path."""
+    att = (attester_slashing.attestation_1 if which == 1
+           else attester_slashing.attestation_2)
+    indices = list(att.attesting_indices)
+    att.attesting_indices = mutate(indices)
+    if resign:
+        sign_indexed_attestation(spec, state, att)
+    return attester_slashing
+
+
+@with_all_phases
+@spec_state_test
+def test_att2_high_index(spec, state):
+    attester_slashing = get_valid_attester_slashing(spec, state, signed_1=True, signed_2=True)
+    yield from run_attester_slashing_processing(
+        spec, state,
+        _mutate_indices(spec, state, attester_slashing, 2,
+                        lambda ix: ix + [len(state.validators)], resign=False),
+        valid=False,
+    )
+
+
+@with_all_phases
+@spec_state_test
+def test_att2_empty_indices(spec, state):
+    attester_slashing = get_valid_attester_slashing(spec, state, signed_1=True, signed_2=False)
+    attester_slashing.attestation_2.attesting_indices = []
+    yield from run_attester_slashing_processing(spec, state, attester_slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_att1_bad_extra_index(spec, state):
+    # an index smuggled in WITHOUT re-signing: aggregate no longer matches
+    attester_slashing = get_valid_attester_slashing(spec, state, signed_1=True, signed_2=True)
+    participants = get_indexed_attestation_participants(spec, attester_slashing.attestation_1)
+    outsider = next(
+        i for i in range(len(state.validators)) if i not in participants
+    )
+    yield from run_attester_slashing_processing(
+        spec, state,
+        _mutate_indices(spec, state, attester_slashing, 1,
+                        lambda ix: sorted(ix + [outsider]), resign=False),
+        valid=False,
+    )
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_att1_bad_replaced_index(spec, state):
+    attester_slashing = get_valid_attester_slashing(spec, state, signed_1=True, signed_2=True)
+    participants = get_indexed_attestation_participants(spec, attester_slashing.attestation_1)
+    outsider = next(
+        i for i in range(len(state.validators)) if i not in participants
+    )
+    yield from run_attester_slashing_processing(
+        spec, state,
+        _mutate_indices(spec, state, attester_slashing, 1,
+                        lambda ix: sorted([outsider] + ix[1:]), resign=False),
+        valid=False,
+    )
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_att2_bad_extra_index(spec, state):
+    attester_slashing = get_valid_attester_slashing(spec, state, signed_1=True, signed_2=True)
+    participants = get_indexed_attestation_participants(spec, attester_slashing.attestation_2)
+    outsider = next(
+        i for i in range(len(state.validators)) if i not in participants
+    )
+    yield from run_attester_slashing_processing(
+        spec, state,
+        _mutate_indices(spec, state, attester_slashing, 2,
+                        lambda ix: sorted(ix + [outsider]), resign=False),
+        valid=False,
+    )
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_att2_bad_replaced_index(spec, state):
+    attester_slashing = get_valid_attester_slashing(spec, state, signed_1=True, signed_2=True)
+    participants = get_indexed_attestation_participants(spec, attester_slashing.attestation_2)
+    outsider = next(
+        i for i in range(len(state.validators)) if i not in participants
+    )
+    yield from run_attester_slashing_processing(
+        spec, state,
+        _mutate_indices(spec, state, attester_slashing, 2,
+                        lambda ix: sorted([outsider] + ix[1:]), resign=False),
+        valid=False,
+    )
+
+
+@with_all_phases
+@spec_state_test
+def test_att1_duplicate_index_normal_signed(spec, state):
+    # a duplicated index breaks the sorted-and-unique requirement even when
+    # the signature is re-computed over the padded list
+    attester_slashing = get_valid_attester_slashing(spec, state, signed_1=True, signed_2=True)
+    yield from run_attester_slashing_processing(
+        spec, state,
+        _mutate_indices(spec, state, attester_slashing, 1,
+                        lambda ix: sorted(ix + [ix[0]])),
+        valid=False,
+    )
+
+
+@with_all_phases
+@spec_state_test
+def test_att2_duplicate_index_normal_signed(spec, state):
+    attester_slashing = get_valid_attester_slashing(spec, state, signed_1=True, signed_2=True)
+    yield from run_attester_slashing_processing(
+        spec, state,
+        _mutate_indices(spec, state, attester_slashing, 2,
+                        lambda ix: sorted(ix + [ix[0]])),
+        valid=False,
+    )
+
+
+@with_all_phases
+@spec_state_test
+def test_unsorted_att_2(spec, state):
+    attester_slashing = get_valid_attester_slashing(spec, state, signed_1=True, signed_2=True)
+    yield from run_attester_slashing_processing(
+        spec, state,
+        _mutate_indices(spec, state, attester_slashing, 2,
+                        lambda ix: list(reversed(ix))),
+        valid=False,
+    )
+
+
+@with_all_phases
+@spec_state_test
+def test_success_attestations_from_future(spec, state):
+    # slashable data with epochs ahead of the state clock is still slashable
+    attester_slashing = get_valid_attester_slashing(spec, state, signed_1=False, signed_2=False)
+    attester_slashing.attestation_1.data.target.epoch += 10
+    attester_slashing.attestation_2.data.target.epoch += 10
+    attester_slashing.attestation_1.data.source.epoch += 2
+    sign_indexed_attestation(spec, state, attester_slashing.attestation_1)
+    sign_indexed_attestation(spec, state, attester_slashing.attestation_2)
+    # double vote at the (future) target epoch
+    assert spec.is_slashable_attestation_data(
+        attester_slashing.attestation_1.data, attester_slashing.attestation_2.data
+    )
+    yield from run_attester_slashing_processing(spec, state, attester_slashing)

@@ -7,8 +7,8 @@ from ...context import (
     always_bls, spec_state_test, with_all_phases,
 )
 from ...helpers.deposits import (
-    build_deposit, prepare_state_and_deposit, run_deposit_processing,
-    sign_deposit_data,
+    build_deposit, build_deposit_tree_and_root, prepare_state_and_deposit,
+    run_deposit_processing, sign_deposit_data,
 )
 from ...helpers.keys import privkeys, pubkeys
 
@@ -193,3 +193,48 @@ def test_key_validate_invalid_subgroup(spec, state):
     state.eth1_data.deposit_count = 1
 
     yield from run_deposit_processing(spec, state, deposit, validator_index, effective=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_new_deposit_non_versioned_withdrawal_credentials(spec, state):
+    # any credential prefix is accepted at deposit time — versioning is a
+    # withdrawal-time concern
+    validator_index = len(state.validators)
+    amount = spec.MAX_EFFECTIVE_BALANCE
+    deposit = prepare_state_and_deposit(
+        spec, state, validator_index, amount, signed=True,
+        withdrawal_credentials=b'\xff' + b'\x02' * 31,
+    )
+    yield from run_deposit_processing(spec, state, deposit, validator_index)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_sig_other_fork_version(spec, state):
+    # deposits always verify under GENESIS_FORK_VERSION: a signature
+    # computed with another version must be treated as an invalid proof of
+    # possession (deposit still absorbed with no validator created)
+    validator_index = len(state.validators)
+    amount = spec.MAX_EFFECTIVE_BALANCE
+    deposit = prepare_state_and_deposit(spec, state, validator_index, amount, signed=False)
+
+    domain = spec.compute_domain(
+        spec.DOMAIN_DEPOSIT, fork_version=spec.Version(b'\x09\x09\x09\x09')
+    )
+    signing_root = spec.compute_signing_root(
+        spec.DepositMessage(
+            pubkey=deposit.data.pubkey,
+            withdrawal_credentials=deposit.data.withdrawal_credentials,
+            amount=deposit.data.amount,
+        ),
+        domain,
+    )
+    deposit.data.signature = spec.bls.Sign(privkeys[validator_index], signing_root)
+    # re-anchor the deposit root to the mutated data
+    _, state.eth1_data.deposit_root = build_deposit_tree_and_root(spec, [deposit.data])
+
+    yield from run_deposit_processing(
+        spec, state, deposit, validator_index, effective=False
+    )

@@ -172,3 +172,56 @@ def test_validator_not_active_long_enough(spec, state):
     )
 
     yield from run_voluntary_exit_processing(spec, state, signed_voluntary_exit, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_default_exit_epoch_subsequent_exit(spec, state):
+    # a second exit in the same epoch lands on the SAME earliest exit epoch
+    # until the churn fills
+    _fast_forward_to_exitable(spec, state)
+    current_epoch = spec.get_current_epoch(state)
+    indices = spec.get_active_validator_indices(state, current_epoch)[-2:]
+
+    first = sign_voluntary_exit(
+        spec, state,
+        spec.VoluntaryExit(epoch=current_epoch, validator_index=indices[0]),
+        privkeys[indices[0]],
+    )
+    spec.process_voluntary_exit(state, first)
+    first_exit_epoch = state.validators[indices[0]].exit_epoch
+
+    second = sign_voluntary_exit(
+        spec, state,
+        spec.VoluntaryExit(epoch=current_epoch, validator_index=indices[1]),
+        privkeys[indices[1]],
+    )
+    yield from run_voluntary_exit_processing(spec, state, second)
+    assert state.validators[indices[1]].exit_epoch == first_exit_epoch
+
+
+@with_all_phases
+@spec_state_test
+def test_exit_queue_spills_past_churn(spec, state):
+    # more exits than the per-epoch churn: the queue epoch advances
+    _fast_forward_to_exitable(spec, state)
+    current_epoch = spec.get_current_epoch(state)
+    churn = int(spec.get_validator_churn_limit(state))
+    indices = spec.get_active_validator_indices(state, current_epoch)[: churn + 1]
+
+    for index in indices[:-1]:
+        exit_op = sign_voluntary_exit(
+            spec, state,
+            spec.VoluntaryExit(epoch=current_epoch, validator_index=index),
+            privkeys[index],
+        )
+        spec.process_voluntary_exit(state, exit_op)
+    base_epoch = state.validators[indices[0]].exit_epoch
+
+    last = sign_voluntary_exit(
+        spec, state,
+        spec.VoluntaryExit(epoch=current_epoch, validator_index=indices[-1]),
+        privkeys[indices[-1]],
+    )
+    yield from run_voluntary_exit_processing(spec, state, last)
+    assert state.validators[indices[-1]].exit_epoch == base_epoch + 1

@@ -90,3 +90,61 @@ def test_minimal_penalty(spec, state):
 def test_empty_slashings(spec, state):
     # no slashings, no penalties
     yield from run_epoch_processing_with(spec, state, 'process_slashings')
+
+
+@with_all_phases
+@spec_state_test
+def test_scaled_penalties(spec, state):
+    # slash ~6% of the set: penalties scale with the slashed fraction and
+    # round down to whole effective-balance increments
+    from random import Random
+
+    rng = Random(5050)
+    n = len(state.validators)
+    count = max(2, n // 16)
+    indices = rng.sample(range(n), count)
+    # diversify effective balances below the max
+    for j, i in enumerate(indices):
+        state.validators[i].effective_balance = spec.Gwei(
+            int(spec.MAX_EFFECTIVE_BALANCE)
+            - (j % 3) * int(spec.EFFECTIVE_BALANCE_INCREMENT)
+        )
+    out_epoch = spec.get_current_epoch(state) + (
+        spec.EPOCHS_PER_SLASHINGS_VECTOR // 2
+    )
+    slash_validators(spec, state, indices, [out_epoch] * count)
+
+    total_balance = spec.get_total_active_balance(state)
+    total_penalties = sum(state.slashings)
+
+    # capture balances only after the earlier sub-passes ran (they may
+    # touch balances once the start state is not genesis)
+    run_epoch_processing_to(spec, state, 'process_slashings')
+    pre_balances = [int(state.balances[i]) for i in indices]
+    yield 'pre', state
+    spec.process_slashings(state)
+    yield 'post', state
+
+    for i, pre in zip(indices, pre_balances):
+        v = state.validators[i]
+        expected_penalty = (
+            int(v.effective_balance) // int(spec.EFFECTIVE_BALANCE_INCREMENT)
+            * min(int(total_penalties) * int(get_slashing_multiplier(spec)), int(total_balance))
+            // int(total_balance)
+            * int(spec.EFFECTIVE_BALANCE_INCREMENT)
+        )
+        assert int(state.balances[i]) == pre - expected_penalty
+
+
+@with_all_phases
+@spec_state_test
+def test_no_penalty_outside_withdrawable_window(spec, state):
+    # a slashed validator whose halfway-point epoch is elsewhere takes no
+    # penalty from this pass
+    slash_validators(
+        spec, state, [1],
+        [spec.get_current_epoch(state) + spec.EPOCHS_PER_SLASHINGS_VECTOR // 4],
+    )
+    pre = int(state.balances[1])
+    yield from run_epoch_processing_with(spec, state, 'process_slashings')
+    assert int(state.balances[1]) == pre
