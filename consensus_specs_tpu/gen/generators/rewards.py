@@ -6,7 +6,14 @@ from ..gen_from_tests import run_state_test_generators
 _T = "consensus_specs_tpu.test"
 
 MODS = {"basic": f"{_T}.phase0.rewards.test_rewards"}
-ALL_MODS = {fork: MODS for fork in ("phase0", "altair", "merge")}
+ALTAIR_MODS = dict(
+    MODS, inactivity_scores=f"{_T}.altair.rewards.test_inactivity_scores"
+)
+ALL_MODS = {
+    "phase0": MODS,
+    "altair": ALTAIR_MODS,
+    "merge": ALTAIR_MODS,
+}
 
 
 def main(args=None) -> int:

@@ -1,0 +1,1 @@
+from consensus_specs_tpu.test.altair.rewards.test_inactivity_scores import *  # noqa: F401,F403
