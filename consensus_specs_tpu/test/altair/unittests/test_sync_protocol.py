@@ -68,6 +68,7 @@ def test_process_light_client_update_not_timeout(spec, state):
         sync_committee_bits=[True] * int(spec.SYNC_COMMITTEE_SIZE),
         # header itself is signed when no finality header is present
         sync_committee_signature=_sign_header(spec, state, update_header, committee_indices),
+        fork_version=state.fork.current_version,
     )
 
     pre_snapshot_root = spec.hash_tree_root(store.snapshot)
@@ -114,6 +115,7 @@ def test_process_light_client_update_finality_updated(spec, state):
         sync_committee_bits=[True] * int(spec.SYNC_COMMITTEE_SIZE),
         # the finality header is the signed header in the finalized flow
         sync_committee_signature=_sign_header(spec, state, finality_header, committee_indices),
+        fork_version=state.fork.current_version,
     )
 
     spec.process_light_client_update(
@@ -172,6 +174,7 @@ def test_validate_light_client_update_bad_finality_proof_rejected(spec, state):
         finality_branch=fin_branch,
         sync_committee_bits=[True] * int(spec.SYNC_COMMITTEE_SIZE),
         sync_committee_signature=_sign_header(spec, state, finality_header, committee_indices),
+        fork_version=state.fork.current_version,
     )
     expect_assertion_error(lambda: spec.validate_light_client_update(
         snapshot, update, state.genesis_validators_root
@@ -208,6 +211,7 @@ def test_process_light_client_update_timeout_forces_best(spec, state):
             sync_committee_signature=_sign_header(
                 spec, state, header, participants
             ),
+            fork_version=state.fork.current_version,
         )
 
     # two queued updates without finality proofs; neither applies yet
