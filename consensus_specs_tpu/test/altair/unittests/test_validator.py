@@ -104,3 +104,78 @@ def test_sync_committee_selection_deterministic(spec, state):
     for p in proofs:
         a = spec.is_sync_committee_aggregator(p)
         assert a == spec.is_sync_committee_aggregator(p)
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+def test_process_sync_committee_contributions_assembles_aggregate(spec, state):
+    # contributions from every subnet fold into one block-level aggregate
+    from ...helpers.sync_committee import compute_sync_committee_signing_root
+
+    block = spec.BeaconBlock(slot=state.slot + 1)
+    committee = get_committee_indices(spec, state)
+    signing_root = compute_sync_committee_signing_root(spec, state, block.slot)
+    subnet_count = int(spec.SYNC_COMMITTEE_SUBNET_COUNT)
+    per_subnet = int(spec.SYNC_COMMITTEE_SIZE) // subnet_count
+
+    contributions = []
+    for subnet in range(subnet_count):
+        seats = range(subnet * per_subnet, (subnet + 1) * per_subnet)
+        bits = [False] * per_subnet
+        sigs = []
+        for off, seat in enumerate(seats):
+            bits[off] = True
+            sigs.append(spec.bls.Sign(privkeys[committee[seat]], signing_root))
+        contributions.append(spec.SyncCommitteeContribution(
+            slot=block.slot,
+            beacon_block_root=spec.Root(),
+            subcommittee_index=subnet,
+            aggregation_bits=bits,
+            signature=spec.bls.Aggregate(sigs),
+        ))
+
+    spec.process_sync_committee_contributions(block, set(contributions))
+    assert all(bool(b) for b in block.body.sync_aggregate.sync_committee_bits)
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+@always_bls
+def test_sync_committee_selection_proof_verifies(spec, state):
+    slot = state.slot
+    subcommittee_index = spec.uint64(0)
+    validator_index = 5
+    proof = spec.get_sync_committee_selection_proof(
+        state, slot, subcommittee_index, privkeys[validator_index]
+    )
+    domain = spec.get_domain(
+        state, spec.DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF,
+        spec.compute_epoch_at_slot(slot),
+    )
+    signing_data = spec.SyncAggregatorSelectionData(
+        slot=slot, subcommittee_index=subcommittee_index
+    )
+    signing_root = spec.compute_signing_root(signing_data, domain)
+    assert spec.bls.Verify(pubkeys[validator_index], signing_root, proof)
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+def test_is_sync_committee_aggregator_threshold(spec, state):
+    # the selection rule is a hash-mod threshold: deterministic for a fixed
+    # signature, and at least sometimes true over a spread of inputs
+    hits = 0
+    trials = 64
+    for i in range(trials):
+        sig = spec.bls.Sign(privkeys[i % 16 + 1], i.to_bytes(32, 'little'))
+        a = spec.is_sync_committee_aggregator(sig)
+        b = spec.is_sync_committee_aggregator(sig)
+        assert a == b
+        hits += int(a)
+    modulo = max(1, int(spec.SYNC_COMMITTEE_SIZE)
+                 // int(spec.SYNC_COMMITTEE_SUBNET_COUNT)
+                 // int(spec.TARGET_AGGREGATORS_PER_SYNC_SUBCOMMITTEE))
+    if modulo == 1:
+        assert hits == trials  # everyone aggregates on the minimal shape
+    else:
+        assert 0 < hits < trials

@@ -145,3 +145,34 @@ def test_is_valid_genesis_state_false_not_enough_validator(spec):
     yield 'genesis', state
     assert not spec.is_valid_genesis_state(state)
     yield 'is_valid', 'meta', False
+
+
+@with_phases([PHASE0])
+@with_presets([MINIMAL], reason="too slow")
+@spec_test
+def test_is_valid_genesis_state_true_more_balance(spec):
+    # an over-funded validator set is still a valid genesis
+    state = create_valid_beacon_state(spec)
+    state.validators[0].effective_balance = spec.MAX_EFFECTIVE_BALANCE
+    state.balances[0] = spec.MAX_EFFECTIVE_BALANCE + spec.EFFECTIVE_BALANCE_INCREMENT
+
+    yield 'genesis', state
+    assert spec.is_valid_genesis_state(state)
+    yield 'is_valid', 'meta', True
+
+
+@with_phases([PHASE0])
+@with_presets([MINIMAL], reason="too slow")
+@spec_test
+def test_is_valid_genesis_state_true_one_more_validator(spec):
+    deposit_count = int(spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT) + 1
+    deposits, _, _ = prepare_full_genesis_deposits(
+        spec, spec.MAX_EFFECTIVE_BALANCE, deposit_count, signed=True
+    )
+    state = spec.initialize_beacon_state_from_eth1(
+        b'\x12' * 32, spec.config.MIN_GENESIS_TIME, deposits
+    )
+
+    yield 'genesis', state
+    assert spec.is_valid_genesis_state(state)
+    yield 'is_valid', 'meta', True

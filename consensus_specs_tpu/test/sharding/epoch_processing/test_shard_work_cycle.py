@@ -68,12 +68,16 @@ def test_stale_voted_header_wins_confirmation(spec, state):
     header_root = spec.hash_tree_root(signed.message)
 
     # a below-threshold vote: not enough for expedited confirmation, but the
-    # heaviest pending header at the epoch boundary
+    # heaviest pending header at the epoch boundary (signed AFTER the vote
+    # is set so real-BLS runs verify)
+    from ...helpers.attestations import sign_attestation
+
     attestation = get_valid_attestation(
         spec, state, slot=slot, index=0,
         filter_participant_set=lambda s: set(list(sorted(s))[:1]),
     )
     attestation.data.shard_blob_root = header_root
+    sign_attestation(spec, state, attestation)
     spec.process_attestation(state, attestation)
     assert _work(spec, state, slot, 0).status.selector == spec.SHARD_WORK_PENDING
 
