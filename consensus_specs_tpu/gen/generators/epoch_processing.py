@@ -21,10 +21,20 @@ ALTAIR_MODS = combine_mods(PHASE0_MODS, {
         f"{_T}.altair.epoch_processing.test_process_sync_committee_updates",
 })
 
+# draft forks: only the handlers whose suites run under them (the
+# shard-work-cycle module declares with_phases([SHARDING, CUSTODY_GAME]))
+SHARDING_MODS = {
+    "pending_shard_confirmations":
+        f"{_T}.sharding.epoch_processing.test_shard_work_cycle",
+}
+CUSTODY_GAME_MODS = dict(SHARDING_MODS)
+
 ALL_MODS = {
     "phase0": PHASE0_MODS,
     "altair": ALTAIR_MODS,
     "merge": ALTAIR_MODS,
+    "sharding": SHARDING_MODS,
+    "custody_game": CUSTODY_GAME_MODS,
 }
 
 

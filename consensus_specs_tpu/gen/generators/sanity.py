@@ -20,10 +20,16 @@ MERGE_MODS = combine_mods(ALTAIR_MODS, {
     "blocks": f"{_T}.merge.sanity.test_blocks",
 })
 
+# custody sanity blocks run the full draft-fork block pipeline
+CUSTODY_GAME_MODS = {
+    "blocks": f"{_T}.custody_game.sanity.test_blocks",
+}
+
 ALL_MODS = {
     "phase0": PHASE0_MODS,
     "altair": ALTAIR_MODS,
     "merge": MERGE_MODS,
+    "custody_game": CUSTODY_GAME_MODS,
 }
 
 
