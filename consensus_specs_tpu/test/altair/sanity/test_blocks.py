@@ -84,8 +84,8 @@ def test_block_with_attestation_and_exit_mix(spec, state):
     from ...helpers.voluntary_exits import prepare_signed_exits
 
     # age the validators past the exit-eligibility threshold
-    for _ in range(int(spec.config.SHARD_COMMITTEE_PERIOD) + 1):
-        next_epoch(spec, state)
+    state.slot += spec.config.SHARD_COMMITTEE_PERIOD * spec.SLOTS_PER_EPOCH
+    next_epoch(spec, state)
     next_slot(spec, state)
 
     attestation = get_valid_attestation(spec, state, slot=state.slot - 1, signed=True)

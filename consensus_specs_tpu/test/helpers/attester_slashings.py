@@ -2,8 +2,8 @@
 from .attestations import get_valid_attestation, sign_attestation, sign_indexed_attestation
 
 
-def get_valid_attester_slashing(spec, state, slot=None, signed_1=False, signed_2=False):
-    attestation_1 = get_valid_attestation(spec, state, slot=slot, signed=signed_1)
+def get_valid_attester_slashing(spec, state, slot=None, index=None, signed_1=False, signed_2=False):
+    attestation_1 = get_valid_attestation(spec, state, slot=slot, index=index, signed=signed_1)
 
     attestation_2 = attestation_1.copy()
     attestation_2.data.target.root = b'\x01' * 32

@@ -592,8 +592,8 @@ def test_duplicate_attestation_in_block_allowed(spec, state):
 @spec_state_test
 def test_exit_then_slash_in_sequence(spec, state):
     # exit a validator via block N, slash it via block N+1 — both must land
-    for _ in range(int(spec.config.SHARD_COMMITTEE_PERIOD) + 1):
-        next_epoch(spec, state)
+    state.slot += spec.config.SHARD_COMMITTEE_PERIOD * spec.SLOTS_PER_EPOCH
+    next_epoch(spec, state)
     target = len(state.validators) - 2
     exits = prepare_signed_exits(spec, state, [target])
 
@@ -613,3 +613,68 @@ def test_exit_then_slash_in_sequence(spec, state):
     yield 'blocks', [signed_block_1, signed_block_2]
     yield 'post', state
     assert any(state.validators[i].slashed for i in slashed_any)
+
+
+@with_all_phases
+@spec_state_test
+def test_multiple_attester_slashings_in_block(spec, state):
+    # distinct slashable pairs against distinct committees in one block
+    next_epoch(spec, state)
+    next_slot(spec, state)
+    s1 = get_valid_attester_slashing(
+        spec, state, slot=state.slot - 1, index=0, signed_1=True, signed_2=True
+    )
+    s2 = get_valid_attester_slashing(
+        spec, state, slot=state.slot - 1, index=1, signed_1=True, signed_2=True
+    )
+    set_1 = set(s1.attestation_1.attesting_indices)
+    set_2 = set(s2.attestation_1.attesting_indices)
+    if set_1 & set_2:
+        import pytest
+        pytest.skip("committees overlap in this configuration")
+
+    yield 'pre', state
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.attester_slashings = [s1, s2]
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield 'blocks', [signed_block]
+    yield 'post', state
+    assert any(state.validators[i].slashed for i in set_1)
+    assert any(state.validators[i].slashed for i in set_2)
+
+
+@with_all_phases
+@spec_state_test
+def test_proposer_slashing_and_exit_same_block(spec, state):
+    state.slot += spec.config.SHARD_COMMITTEE_PERIOD * spec.SLOTS_PER_EPOCH
+    next_epoch(spec, state)
+    slashing = get_valid_proposer_slashing(spec, state, signed_1=True, signed_2=True)
+    slashed = slashing.signed_header_1.message.proposer_index
+    exit_target = next(
+        i for i in range(len(state.validators) - 1, -1, -1) if i != slashed
+    )
+    exits = prepare_signed_exits(spec, state, [exit_target])
+
+    yield 'pre', state
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.proposer_slashings = [slashing]
+    block.body.voluntary_exits = exits
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield 'blocks', [signed_block]
+    yield 'post', state
+    assert state.validators[slashed].slashed
+    assert state.validators[exit_target].exit_epoch < spec.FAR_FUTURE_EPOCH
+
+
+@with_all_phases
+@spec_state_test
+def test_expected_deposit_count_enforced(spec, state):
+    # state says a deposit is due but the block carries none
+    state.eth1_data.deposit_count = state.eth1_deposit_index + 1
+    block = build_empty_block_for_next_slot(spec, state)
+    yield 'pre', state
+    expect_assertion_error(
+        lambda: transition_unsigned_block(spec, state, block)
+    )
+    yield 'blocks', [spec.SignedBeaconBlock(message=block)]
+    yield 'post', None
