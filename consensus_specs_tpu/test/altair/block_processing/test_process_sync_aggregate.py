@@ -198,13 +198,13 @@ def test_sync_committee_proposer_in_committee(spec, state):
 
 
 def _random_bits(spec, fraction_num, fraction_den, seed):
-    """Deterministic participation pattern covering fraction_num/fraction_den
+    """Seeded random participation pattern covering ~fraction_num/fraction_den
     of the committee."""
+    from random import Random
+
+    rng = Random(seed)
     size = int(spec.SYNC_COMMITTEE_SIZE)
-    return [
-        ((i * 2654435761 + seed * 40503) % fraction_den) < fraction_num
-        for i in range(size)
-    ]
+    return [rng.randrange(fraction_den) < fraction_num for _ in range(size)]
 
 
 @with_phases([ALTAIR])
@@ -320,3 +320,52 @@ def test_proposer_reward_sums_over_participants(spec, state):
     )
 
     assert int(state.balances[proposer_index]) == pre + sum(bits) * int(proposer_reward)
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+def test_sync_committee_random_pattern_seed_4(spec, state):
+    _prepare(spec, state)
+    bits = _random_bits(spec, 2, 3, seed=4)
+    yield from run_sync_aggregate_processing(
+        spec, state, build_sync_aggregate(spec, state, bits)
+    )
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+def test_sync_committee_random_pattern_seed_5(spec, state):
+    _prepare(spec, state)
+    bits = _random_bits(spec, 1, 8, seed=5)
+    if not any(bits):
+        bits[0] = True
+    yield from run_sync_aggregate_processing(
+        spec, state, build_sync_aggregate(spec, state, bits)
+    )
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+def test_sync_committee_with_low_balance_participant(spec, state):
+    # seat rewards key off base rewards, not the member's own balance
+    _prepare(spec, state)
+    committee = get_committee_indices(spec, state)
+    state.balances[committee[0]] = spec.Gwei(1)
+    bits = [True] * int(spec.SYNC_COMMITTEE_SIZE)
+    yield from run_sync_aggregate_processing(
+        spec, state, build_sync_aggregate(spec, state, bits)
+    )
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+def test_sync_committee_nonparticipant_with_zero_balance_floors(spec, state):
+    # the penalty saturates at zero balance rather than underflowing
+    _prepare(spec, state)
+    committee = get_committee_indices(spec, state)
+    state.balances[committee[-1]] = spec.Gwei(0)
+    bits = [True] * int(spec.SYNC_COMMITTEE_SIZE)
+    bits[-1] = False
+    yield from run_sync_aggregate_processing(
+        spec, state, build_sync_aggregate(spec, state, bits)
+    )

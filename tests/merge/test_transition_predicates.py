@@ -1,0 +1,1 @@
+from consensus_specs_tpu.test.merge.unittests.test_transition_predicates import *  # noqa: F401,F403
