@@ -97,4 +97,43 @@ try:
     p('representation ratio u32/u64 = %.2fx' % (r32 / r64))
 except Exception as e:
     p('rep shootout FAILED: %r' % (repr(e)[:400]))
+# Pallas mont_mul kernel (ops/pallas_fq.py): first Mosaic compile + A/B vs
+# the jnp u64 lowering on the granted device. This measurement decides
+# whether fq.mont_mul's CONSENSUS_SPECS_TPU_PALLAS dispatch defaults on.
+arm()
+try:
+    from consensus_specs_tpu.ops import pallas_fq
+    import numpy as np
+
+    batch, iters = 4096, 32
+    xs = [(i * 0x9E3779B97F4A7C15 + 1) % fq.P for i in range(batch)]
+    a = np.stack([fq.to_mont_int(x) for x in xs])
+    b = np.stack([fq.to_mont_int((x * 7 + 3) % fq.P) for x in xs])
+    da, db = jax.device_put(a), jax.device_put(b)
+    # jit-wrapped exactly like bench_rep's jnp baseline so the A/B compares
+    # one compiled computation per iteration on both sides
+    fp = jax.jit(pallas_fq.mont_mul)
+    t0 = time.time()
+    out = fp(da, db)
+    out.block_until_ready()
+    compile_s = time.time() - t0
+    got = fq.from_mont_limbs(np.asarray(out)[0])
+    want = xs[0] * ((xs[0] * 7 + 3) % fq.P) % fq.P
+    t0 = time.time()
+    o = da
+    for _ in range(iters):
+        o = fp(o, db)
+    o.block_until_ready()
+    dt = time.time() - t0
+    # validate the CHAINED product too (kernel consuming its own loose
+    # output), mirroring bench_rep — a single-call match is not enough to
+    # promote the kernel
+    chain_got = fq.from_mont_limbs(np.asarray(o)[0])
+    chain_want = xs[0]
+    for _ in range(iters):
+        chain_want = chain_want * ((xs[0] * 7 + 3) % fq.P) % fq.P
+    p('pallas_mont_mul %.0f mul/s (compile %.1fs, run %.2fs) match=%s chain_match=%s'
+      % (batch * iters / dt, compile_s, dt, got == want, chain_got == chain_want))
+except Exception as e:
+    p('pallas_mont_mul FAILED: %r' % (repr(e)[:400]))
 p('=== probe end', time.strftime('%H:%M:%S'))
