@@ -75,6 +75,15 @@ def _workload_params(on_cpu: bool, override=None):
 TARGET_PER_CHIP = 150_000 / 8  # north star: 300k sigs < 2 s on 8 chips
 
 
+def _bench_env_overridden() -> bool:
+    """True when the caller pinned any workload knob — quick-path
+    substitutions must then step aside (env overrides always win)."""
+    return any(
+        os.environ.get(v) is not None
+        for v in ("BENCH_N", "BENCH_K", "BENCH_REPS", "BENCH_MODE")
+    )
+
+
 def run_workload(emit_partial=None, override=None, child_quick=False) -> dict:
     """Run the configured workload on whatever platform jax resolves to.
     Returns the final result dict (not yet printed); ``emit_partial`` is
@@ -87,12 +96,7 @@ def run_workload(emit_partial=None, override=None, child_quick=False) -> dict:
     import jax
 
     platform = jax.default_backend()
-    if (
-        child_quick
-        and platform == "cpu"
-        and os.environ.get("BENCH_N") is None
-        and os.environ.get("BENCH_MODE", "committee") == "committee"
-    ):
+    if child_quick and platform == "cpu" and not _bench_env_overridden():
         override = (4, 8, 1, "committee")
     n, k, reps, mode = _workload_params(on_cpu=platform == "cpu", override=override)
 
@@ -262,7 +266,7 @@ def main():
 
     force_cpu()
     _, _, _, mode = _workload_params(on_cpu=True)
-    if mode == "committee" and os.environ.get("BENCH_N") is None:
+    if mode == "committee" and not _bench_env_overridden():
         quick = run_workload(override=(4, 8, 1, "committee"))
         quick["stage"] = "fallback liveness pre-pass (n=4, k=8)"
         if tpu_error is not None:

@@ -27,7 +27,12 @@ SHARDING_MODS = {
     "pending_shard_confirmations":
         f"{_T}.sharding.epoch_processing.test_shard_work_cycle",
 }
-CUSTODY_GAME_MODS = dict(SHARDING_MODS)
+# custody adds its own epoch passes (reveal/challenge deadlines, final
+# updates — test_custody_epoch_passes covers all three handlers' suites)
+CUSTODY_GAME_MODS = combine_mods(SHARDING_MODS, {
+    "custody_epoch_passes":
+        f"{_T}.custody_game.epoch_processing.test_custody_epoch_passes",
+})
 
 ALL_MODS = {
     "phase0": PHASE0_MODS,

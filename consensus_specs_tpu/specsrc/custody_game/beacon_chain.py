@@ -22,6 +22,20 @@
 #     byte-length mixed in) a concrete tree to verify against.
 #   * process_block/process_epoch extend the CURRENT sharding versions
 #     (the draft text extends a stale phase0-era pipeline).
+#
+# KNOWN DRAFT INCONSISTENCY (inherited, deliberately NOT reconciled):
+# `body_summary.data_root` has two irreconcilable meanings across the layered
+# drafts. The sharding draft defines it as hash_tree_root(List[BLSPoint])
+# (32-byte field-element serialization, sharding/beacon-chain.md:260-331),
+# while the custody handlers here require compute_custody_data_root over
+# samples_count * 248 raw bytes. Consequently a header accepted by
+# process_shard_header with a real KZG commitment (helpers/shard_blob.py)
+# can never satisfy a chunk-challenge response or custody slashing, and
+# custody-test headers carry empty commitments that process_shard_header
+# would reject. The two subsystems are therefore exercised by DISJOINT test
+# fixtures. Reconciling (defining the sharding data field as the
+# 248-byte/sample ByteList view so one blob satisfies both) would diverge
+# from the normative sharding text, so the split is kept and documented.
 
 # ---------------------------------------------------------------------------
 # constants (custody_game/beacon-chain.md:63-80)

@@ -108,6 +108,12 @@ def create_genesis_state(spec, validator_balances, activation_threshold):
         # validators: deterministic keys, funded to cover test fees.
         state.shard_sample_price = spec.MIN_SAMPLE_PRICE
         num_builders = 4
+        # builders draw from the TAIL of the shared key list — a validator
+        # count close to the pool size would silently alias a builder key
+        # with a validator key and corrupt signature-domain tests
+        assert len(state.validators) + num_builders <= len(pubkeys), (
+            "validator count leaves no headroom for distinct builder keys"
+        )
         state.blob_builders = [
             spec.Builder(pubkey=pubkeys[-(1 + i)]) for i in range(num_builders)
         ]

@@ -382,6 +382,10 @@ def verify_degree_proof(setup: Setup, commitment, degree_proof,
     (reference specs/sharding/beacon-chain.md:717-721):
     e(degree_proof, G2[0]) == e(commitment, G2[n - points_count]) proves
     deg(p) < points_count, with degree_proof = commit(p * X^(n - points_count))."""
+    # a points_count above n would make the shift negative and (for lazy
+    # setups) wrap Python-style to an unrelated point; 0 would index g2[n].
+    # Reject both — don't wrap, don't IndexError
+    assert 0 < points_count <= setup.n, "points_count outside 1..setup.n"
     shift = setup.n - points_count
     res = curve.multi_pairing([
         (curve.ec_to_affine(degree_proof), curve.ec_to_affine(setup.g2[0])),
@@ -394,6 +398,7 @@ def degree_proof(setup: Setup, coeffs: Sequence[int], points_count: int):
     """commit(p(X) * X^(n - points_count)) — only exists when
     deg(p) < points_count."""
     assert len(coeffs) <= points_count
+    assert 0 <= points_count <= setup.n, "points_count exceeds setup size"
     shift = setup.n - points_count
     shifted = [0] * shift + [c % MODULUS for c in coeffs]
     return commit_to_poly(setup, shifted)
