@@ -13,12 +13,38 @@ Semantics notes (match remerkleable-backed reference behavior):
 - Assigning a composite value INTO a container/list stores a deep copy
   (snapshot semantics, like remerkleable's persistent backing), while reads
   alias, so `state.validators[i].exit_epoch = e` mutates the state.
+
+INCREMENTAL MERKLEIZATION (remerkleable's role, reference
+utils/ssz/ssz_impl.py:12-13; SURVEY §7.3 hard part #6): Vector/List/Bitlist
+keep a cached Merkle layer tree (`_ChunkTree`) plus per-element root/tag
+caches, so `hash_tree_root` after k mutations re-hashes O(k log n) instead
+of O(n). Mutation detection:
+- every mutable view carries `_mut`, a GLOBALLY-UNIQUE monotonically
+  assigned stamp refreshed by each mutator (unique values make the check
+  robust against element replacement);
+- direct mutations (series `__setitem__`/`append`) mark dirty indices;
+- deep mutations through read aliases (`state.validators[i].slashed = x`)
+  are caught by comparing each element's `_mut` stamp against the stamp
+  recorded at the previous hash — an O(n) scan that re-HASHES only changes.
+Stores snapshot (deep-copy) values, so every composite has exactly one
+owner and local caches can never alias-skew. `copy.deepcopy` carries the
+caches over (bytes are shared, structure is copied), keeping genesis-state
+caches warm across per-test copies (reference test/context.py:83-104 relies
+on the same property via remerkleable's structural sharing).
 """
 from __future__ import annotations
 
 import io
+import itertools
 from hashlib import sha256
 from typing import Any, Dict, Optional, Sequence, Tuple, Type
+
+_MUT_COUNTER = itertools.count(1)
+
+
+def _bump(obj) -> None:
+    """Stamp a mutable view with a fresh globally-unique mutation id."""
+    object.__setattr__(obj, "_mut", next(_MUT_COUNTER))
 
 BYTES_PER_CHUNK = 32
 BITS_PER_BYTE = 8
@@ -77,6 +103,84 @@ def _load_native_hash_pairs():
 
 
 _native_hash_pairs = _load_native_hash_pairs()
+
+
+class _ChunkTree:
+    """Merkle layer cache over a virtual zero-padded tree of fixed depth.
+
+    Stores only the PRESENT nodes of each layer (absent right siblings are
+    the zero-subtree hashes), so a List[_, 2^40] with n chunks costs ~2n
+    nodes. `set_chunk`/`append` update the O(log n) root path; `root()`
+    folds the top present node with zero hashes up to the type's depth —
+    bit-identical to `merkleize_chunks` (cross-checked in
+    tests/test_ssz_incremental.py)."""
+
+    __slots__ = ("depth", "layers")
+
+    def __init__(self, depth: int, chunks: Sequence[bytes]):
+        self.depth = depth
+        self.layers = [list(chunks)]
+        self._build_above(0)
+
+    def _build_above(self, level: int) -> None:
+        del self.layers[level + 1 :]
+        cur = self.layers[level]
+        lv = level
+        while len(cur) > 1:
+            src = cur if len(cur) % 2 == 0 else cur + [ZERO_HASHES[lv]]
+            n_pairs = len(src) // 2
+            if n_pairs >= 8 and _native_hash_pairs is not None:
+                digests = _native_hash_pairs(b"".join(src))
+                nxt = [digests[32 * i : 32 * (i + 1)] for i in range(n_pairs)]
+            else:
+                nxt = [
+                    sha256(src[2 * i] + src[2 * i + 1]).digest()
+                    for i in range(n_pairs)
+                ]
+            self.layers.append(nxt)
+            cur = nxt
+            lv += 1
+
+    def _update_path(self, i: int) -> None:
+        for lv in range(len(self.layers) - 1):
+            cur = self.layers[lv]
+            up = self.layers[lv + 1]
+            pi = i // 2
+            left = cur[2 * pi]
+            right = cur[2 * pi + 1] if 2 * pi + 1 < len(cur) else ZERO_HASHES[lv]
+            h = sha256(left + right).digest()
+            if pi == len(up):
+                up.append(h)
+            else:
+                up[pi] = h
+            i = pi
+        # growth past a power-of-two boundary needs a new top layer
+        while len(self.layers[-1]) > 1:
+            self._build_above(len(self.layers) - 1)
+
+    def n_chunks(self) -> int:
+        return len(self.layers[0])
+
+    def set_chunk(self, i: int, chunk: bytes) -> None:
+        self.layers[0][i] = chunk
+        self._update_path(i)
+
+    def append(self, chunk: bytes) -> None:
+        self.layers[0].append(chunk)
+        self._update_path(len(self.layers[0]) - 1)
+
+    def root(self) -> bytes:
+        if not self.layers[0]:
+            return ZERO_HASHES[self.depth]
+        node = self.layers[-1][0]
+        for lv in range(len(self.layers) - 1, self.depth):
+            node = sha256(node + ZERO_HASHES[lv]).digest()
+        return node
+
+
+def _type_depth(limit: int) -> int:
+    width = next_power_of_two(limit)
+    return (width - 1).bit_length()
 
 
 def mix_in_length(root: bytes, length: int) -> bytes:
@@ -503,6 +607,7 @@ class Bitvector(View):
             self._bits = new_bits
         else:
             self._bits[i] = bool(v)
+        _bump(self)
 
     def __iter__(self):
         return iter(self._bits)
@@ -568,7 +673,14 @@ class Bitlist(View):
         return self._bits[i]
 
     def __setitem__(self, i, v):
-        self._bits[i] = bool(v)
+        idx = int(i)
+        if idx < 0:
+            idx += len(self._bits)
+        self._bits[idx] = bool(v)
+        _bump(self)
+        d = getattr(self, "_htr_dirty", None)
+        if d is not None:
+            d.add(idx // 256)
 
     def __iter__(self):
         return iter(self._bits)
@@ -577,6 +689,10 @@ class Bitlist(View):
         if len(self._bits) + 1 > self.LIMIT:
             raise ValueError(f"{type(self).__name__}: append exceeds limit")
         self._bits.append(bool(v))
+        _bump(self)
+        d = getattr(self, "_htr_dirty", None)
+        if d is not None:
+            d.add((len(self._bits) - 1) // 256)
 
     def __eq__(self, other):
         if isinstance(other, Bitlist):
@@ -605,11 +721,31 @@ class Bitlist(View):
         bits = [bool((data[i // 8] >> (i % 8)) & 1) for i in range(total_bits)]
         return cls(bits)
 
+    def _bit_chunk(self, ci: int) -> bytes:
+        return _bits_to_bytes(self._bits[ci * 256 : (ci + 1) * 256]).ljust(32, b"\x00")
+
     def hash_tree_root(self) -> bytes:
-        root = merkleize_chunks(
-            pack_bytes_into_chunks(_bits_to_bytes(self._bits)), limit=(self.LIMIT + 255) // 256
-        )
-        return mix_in_length(root, len(self._bits))
+        """Layer-tree cached (see _ChunkTree): only chunks holding touched
+        bits re-pack and re-hash; a shrink falls back to a full rebuild."""
+        depth = _type_depth((self.LIMIT + 255) // 256)
+        nbits = len(self._bits)
+        n_chunks = (nbits + 255) // 256
+        tree = getattr(self, "_htr_tree", None)
+        dirty = getattr(self, "_htr_dirty", None)
+        prev_nbits = getattr(self, "_htr_nbits", None)
+        if tree is None or dirty is None or prev_nbits is None or nbits < prev_nbits:
+            tree = _ChunkTree(depth, pack_bytes_into_chunks(_bits_to_bytes(self._bits)))
+            self._htr_tree = tree
+        else:
+            prev_chunks = tree.n_chunks()
+            d = {ci for ci in dirty if ci < prev_chunks}
+            for ci in sorted(d):
+                tree.set_chunk(ci, self._bit_chunk(ci))
+            for ci in range(prev_chunks, n_chunks):
+                tree.append(self._bit_chunk(ci))
+        self._htr_dirty = set()
+        self._htr_nbits = nbits
+        return mix_in_length(tree.root(), nbits)
 
     def __repr__(self):
         return f"{type(self).__name__}({self._bits})"
@@ -669,10 +805,102 @@ class ComplexSeries(View):
         return self._elems[int(i)]
 
     def __setitem__(self, i, v):
-        self._elems[int(i)] = _store_elem(self.ELEM_TYPE, v)
+        idx = int(i)
+        if idx < 0:
+            idx += len(self._elems)
+        self._elems[idx] = _store_elem(self.ELEM_TYPE, v)
+        self._mark_dirty(idx)
 
     def __iter__(self):
         return iter(self._elems)
+
+    # -- incremental merkleization machinery -------------------------------
+
+    def _mark_dirty(self, idx: int) -> None:
+        _bump(self)
+        d = getattr(self, "_htr_dirty", None)
+        if d is not None:
+            d.add(idx)
+
+    def _invalidate_htr(self) -> None:
+        _bump(self)
+        self._htr_tree = None
+        self._htr_dirty = None
+
+    def _basic_chunk(self, ci: int, per: int) -> bytes:
+        seg = self._elems[ci * per : (ci + 1) * per]
+        return b"".join(e.encode_bytes() for e in seg).ljust(32, b"\x00")
+
+    def _chunks_root(self) -> bytes:
+        """Bottom merkleization (no length mix-in) with layer-tree caching:
+        only dirty chunks/elements re-hash; the root path updates in
+        O(log n) per dirty chunk. Falls back to a full (native-batched)
+        rebuild when the cache is absent or the series shrank."""
+        typ = type(self)
+        depth = _type_depth(chunk_count(typ))
+        basic = is_basic_type(self.ELEM_TYPE)
+        tree: Optional[_ChunkTree] = getattr(self, "_htr_tree", None)
+        dirty = getattr(self, "_htr_dirty", None)
+
+        if basic:
+            es = self.ELEM_TYPE.type_byte_length()
+            per = 32 // es
+            n_chunks = (len(self._elems) + per - 1) // per
+            if tree is None or dirty is None or n_chunks < tree.n_chunks():
+                tree = _ChunkTree(
+                    depth,
+                    pack_bytes_into_chunks(
+                        b"".join(e.encode_bytes() for e in self._elems)
+                    ),
+                )
+                self._htr_tree = tree
+            else:
+                prev = tree.n_chunks()
+                dchunks = {i // per for i in dirty if i // per < prev}
+                if n_chunks > prev and prev > 0:
+                    dchunks.add(prev - 1)  # boundary chunk gained elements
+                for ci in sorted(dchunks):
+                    tree.set_chunk(ci, self._basic_chunk(ci, per))
+                for ci in range(prev, n_chunks):
+                    tree.append(self._basic_chunk(ci, per))
+            self._htr_dirty = set()
+            return tree.root()
+
+        # composite elements: cache per-element roots + mutation stamps
+        eroots = getattr(self, "_htr_eroots", None)
+        etags = getattr(self, "_htr_etags", None)
+        n = len(self._elems)
+        if tree is None or eroots is None or n < len(eroots):
+            eroots = [e.hash_tree_root() for e in self._elems]
+            etags = [_deep_stamp(e) for e in self._elems]
+            self._htr_tree = tree = _ChunkTree(depth, list(eroots))
+            self._htr_eroots = eroots
+            self._htr_etags = etags
+            self._htr_dirty = set()
+            return tree.root()
+
+        # deep mutations through read aliases: elements whose stamp moved
+        if _mutable_core(self.ELEM_TYPE):
+            dirty = set(dirty)
+            elems = self._elems
+            for i in range(len(eroots)):
+                if _deep_stamp(elems[i]) != etags[i]:
+                    dirty.add(i)
+        for i in sorted(d for d in dirty if d < len(eroots)):
+            e = self._elems[i]
+            r = e.hash_tree_root()
+            etags[i] = _deep_stamp(e)
+            if r != eroots[i]:
+                eroots[i] = r
+                tree.set_chunk(i, r)
+        for i in range(len(eroots), n):  # appended elements
+            e = self._elems[i]
+            r = e.hash_tree_root()
+            eroots.append(r)
+            etags.append(_deep_stamp(e))
+            tree.append(r)
+        self._htr_dirty = set()
+        return tree.root()
 
     def __contains__(self, v):
         return v in self._elems
@@ -703,11 +931,6 @@ class ComplexSeries(View):
 
     def __hash__(self):
         return hash(self.hash_tree_root())
-
-    def _chunks(self) -> Tuple[bytes, ...]:
-        if is_basic_type(self.ELEM_TYPE):
-            return pack_bytes_into_chunks(b"".join(e.encode_bytes() for e in self._elems))
-        return tuple(e.hash_tree_root() for e in self._elems)
 
     def encode_bytes(self) -> bytes:
         return _serialize_series(self.ELEM_TYPE, self._elems)
@@ -752,7 +975,7 @@ class Vector(ComplexSeries):
         return cls(elems)
 
     def hash_tree_root(self) -> bytes:
-        return merkleize_chunks(self._chunks(), limit=chunk_count(type(self)))
+        return self._chunks_root()
 
 
 class List(ComplexSeries):
@@ -784,9 +1007,29 @@ class List(ComplexSeries):
         if len(self._elems) + 1 > self.LIMIT:
             raise ValueError(f"{type(self).__name__}: append exceeds limit {self.LIMIT}")
         self._elems.append(_store_elem(self.ELEM_TYPE, v))
+        self._mark_dirty(len(self._elems) - 1)
 
     def pop(self, i=-1):
-        return self._elems.pop(i)
+        idx = int(i)
+        if idx < 0:
+            idx += len(self._elems)
+        v = self._elems.pop(idx)
+        _bump(self)
+        eroots = getattr(self, "_htr_eroots", None)
+        if eroots is not None and idx < len(eroots):
+            # composite path: splice the cached element root/tag out and
+            # rebuild the layer tree from cached roots (no element rehash);
+            # pending dirty marks shift down with the spliced indices
+            del eroots[idx]
+            del self._htr_etags[idx]
+            self._htr_tree = _ChunkTree(
+                _type_depth(chunk_count(type(self))), list(eroots)
+            )
+            d = getattr(self, "_htr_dirty", None) or set()
+            self._htr_dirty = {j - 1 if j > idx else j for j in d if j != idx}
+        else:
+            self._invalidate_htr()  # basic path: repack chunks on next hash
+        return v
 
     @classmethod
     def decode_bytes(cls, data: bytes) -> "List":
@@ -794,8 +1037,7 @@ class List(ComplexSeries):
         return cls(elems)
 
     def hash_tree_root(self) -> bytes:
-        root = merkleize_chunks(self._chunks(), limit=chunk_count(type(self)))
-        return mix_in_length(root, len(self._elems))
+        return mix_in_length(self._chunks_root(), len(self._elems))
 
 
 # ---------------------------------------------------------------------------
@@ -835,6 +1077,7 @@ class Container(View):
         if typ is None:
             raise AttributeError(f"{type(self).__name__} has no SSZ field {name!r}")
         object.__setattr__(self, name, _store_elem(typ, value))
+        _bump(self)
 
     def __eq__(self, other):
         if type(other) is not type(self):
@@ -979,6 +1222,7 @@ class Union(View):
         else:
             self._value = _store_elem(typ, value if value is not None else typ.default())
         self._selector = selector
+        _bump(self)
 
     @property
     def selector(self) -> int:
@@ -1035,6 +1279,60 @@ class Union(View):
 
     def __repr__(self):
         return f"{type(self).__name__}(selector={self._selector}, value={self._value!r})"
+
+
+# ---------------------------------------------------------------------------
+# deep mutation stamps (incremental-merkleization change detection)
+# ---------------------------------------------------------------------------
+
+_STAMP_PLAN_CACHE: Dict[type, tuple] = {}
+
+
+def _mutable_core(typ) -> bool:
+    """Types whose INSTANCES can be mutated in place (and therefore carry
+    `_mut` stamps). bytes-derived and int-derived views are immutable."""
+    return isinstance(typ, type) and issubclass(
+        typ, (Container, ComplexSeries, Bitvector, Bitlist, Union)
+    )
+
+
+def _container_stamp_fields(typ) -> tuple:
+    """Per-class cache: field names whose subtree can mutate in place.
+    Leaf-only containers (e.g. Validator — all uint/bytes fields) get an
+    empty plan, making their deep stamp a single attribute read."""
+    plan = _STAMP_PLAN_CACHE.get(typ)
+    if plan is None:
+        plan = tuple(
+            n for n, t in typ._field_types.items() if _mutable_core(t)
+        )
+        _STAMP_PLAN_CACHE[typ] = plan
+    return plan
+
+
+def _deep_stamp(v) -> int:
+    """Max mutation stamp over a view's whole subtree. Stamps are globally
+    monotonic, so ANY in-place mutation below `v` after a recorded stamp
+    strictly raises this value — the series caches compare it to decide
+    which element roots to re-hash."""
+    s = getattr(v, "_mut", 0)
+    if isinstance(v, Container):
+        for n in _container_stamp_fields(type(v)):
+            s2 = _deep_stamp(object.__getattribute__(v, n))
+            if s2 > s:
+                s = s2
+    elif isinstance(v, ComplexSeries):
+        if _mutable_core(v.ELEM_TYPE):
+            for e in v._elems:
+                s2 = _deep_stamp(e)
+                if s2 > s:
+                    s = s2
+    elif isinstance(v, Union):
+        val = v._value
+        if val is not None and _mutable_core(type(val)):
+            s2 = _deep_stamp(val)
+            if s2 > s:
+                s = s2
+    return s
 
 
 # ---------------------------------------------------------------------------
