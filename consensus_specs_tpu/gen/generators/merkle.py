@@ -1,12 +1,17 @@
-"""`merkle` test-vector generator: single Merkle proofs over BeaconState
-(reference: the altair light-client merkle single_proof suite; format
-tests/formats/merkle/README.md — leaf, proof branch, generalized index)."""
+"""`merkle` test-vector generator: single Merkle proofs AND multiproofs
+over BeaconState (reference: the altair light-client merkle single_proof
+suite, format tests/formats/merkle/README.md — leaf, proof branch,
+generalized index; multiproof algebra per ssz/merkle-proofs.md:249-357)."""
 import sys
 from random import Random
 
 from ...builder import IMPLEMENTED_FORKS, build_spec_module
 from ...utils.ssz.gindex import get_generalized_index
-from ...utils.ssz.proofs import build_proof
+from ...utils.ssz.proofs import (
+    build_multiproof,
+    build_proof,
+    verify_merkle_multiproof,
+)
 from ..gen_runner import run_generator
 from ..gen_typing import TestCase, TestProvider
 
@@ -45,6 +50,32 @@ def _case(spec, state, path):
     return case_fn
 
 
+MULTI_PATH_SETS = [
+    ("finality_and_fork", (("finalized_checkpoint", "root"), ("fork",))),
+    ("light_client_pair", (("finalized_checkpoint", "root"), ("next_sync_committee",))),  # altair+
+    ("checkpoints_and_slot", (("current_justified_checkpoint",), ("finalized_checkpoint",), ("slot",))),
+]
+
+
+def _multi_case(spec, state, path_set):
+    def case_fn():
+        gindices = [get_generalized_index(spec.BeaconState, *p) for p in path_set]
+        leaves, proof = build_multiproof(state, gindices)
+        assert verify_merkle_multiproof(
+            leaves, proof, gindices, state.hash_tree_root()
+        )
+        return [
+            ("state", "ssz", state.encode_bytes()),
+            ("proof", "data", {
+                "leaf_indices": [int(g) for g in gindices],
+                "leaves": ["0x" + bytes(l).hex() for l in leaves],
+                "proof": ["0x" + bytes(b).hex() for b in proof],
+            }),
+        ]
+
+    return case_fn
+
+
 def make_cases():
     rng = Random(1331)
     for preset in ("minimal",):
@@ -65,6 +96,18 @@ def make_cases():
                     suite_name="pyspec_tests",
                     case_name=name,
                     case_fn=_case(spec, state, path),
+                )
+            for name, path_set in MULTI_PATH_SETS:
+                if any(p[0] not in spec.BeaconState.fields() for p in path_set):
+                    continue
+                yield TestCase(
+                    fork_name=fork,
+                    preset_name=preset,
+                    runner_name="merkle",
+                    handler_name="multiproof",
+                    suite_name="pyspec_tests",
+                    case_name=name,
+                    case_fn=_multi_case(spec, state, path_set),
                 )
 
 

@@ -826,6 +826,11 @@ class ComplexSeries(View):
         _bump(self)
         self._htr_tree = None
         self._htr_dirty = None
+        # element-root caches must die with the tree: pop's splice path
+        # would otherwise resurrect stale roots whose dirty marks were
+        # discarded here
+        self._htr_eroots = None
+        self._htr_etags = None
 
     def _basic_chunk(self, ci: int, per: int) -> bytes:
         seg = self._elems[ci * per : (ci + 1) * per]

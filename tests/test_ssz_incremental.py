@@ -209,6 +209,46 @@ def test_deepcopy_preserves_independence_and_correctness():
     assert dup.hash_tree_root() == fresh_root(dup)
 
 
+def test_pop_cannot_resurrect_stale_roots():
+    """Regression (round-4 review): a pop with idx >= len(cached roots)
+    invalidates the cache and discards pending dirty marks; a later pop
+    must NOT rebuild a tree from the stale element roots (immutable
+    elements like Bytes32 have no stamp scan to recover them)."""
+    L = List[ByteVector[32], 1024]
+    lst = L([ByteVector[32](bytes([i]) * 32) for i in range(10)])
+    lst.hash_tree_root()
+    lst[2] = ByteVector[32](b"\xaa" * 32)  # dirty mark {2}, not yet hashed
+    lst.append(ByteVector[32](b"\xbb" * 32))
+    lst.pop(10)  # idx >= len(eroots): invalidate path
+    lst.pop(5)  # must not splice stale eroots back to life
+    assert lst.hash_tree_root() == fresh_root(lst)
+
+
+def test_proof_descent_does_not_rehash_the_series():
+    """build_proof into one element of a warm large composite list must be
+    O(log n) hashes, not a full element-root sweep per branch node."""
+    from unittest import mock
+
+    import consensus_specs_tpu.utils.ssz.proofs as proofs_mod
+    import consensus_specs_tpu.utils.ssz.ssz_typing as st
+
+    class Holder(Container):
+        items: List[Inner, 1 << 20]
+
+    h = Holder(items=List[Inner, 1 << 20]([Inner(a=uint64(i)) for i in range(5000)]))
+    h.hash_tree_root()  # warm
+    calls = {"n": 0}
+    real = st.sha256
+
+    def counting(*a, **k):
+        calls["n"] += 1
+        return real(*a, **k)
+
+    with mock.patch.object(st, "sha256", counting):
+        proofs_mod.build_proof(h, "items", 1234, "a")
+    assert calls["n"] <= 80, f"proof construction hashed {calls['n']} nodes"
+
+
 def test_incremental_is_sublinear():
     """One mutation in a large list must re-hash O(log n), not O(n): the
     second hash after a point update must do far less work than the first.

@@ -1,0 +1,197 @@
+"""Merkle multiproof tests: construction + verification round-trips over
+live views (reference algebra: ssz/merkle-proofs.md:249-357), packed
+basic-leaf proofs, and a light-client-style multiproof over the altair
+BeaconState authenticating both sync-protocol gindices in one proof."""
+import random
+
+from consensus_specs_tpu.utils.ssz.gindex import get_generalized_index
+from consensus_specs_tpu.utils.ssz.proofs import (
+    build_multiproof,
+    build_proof,
+    calculate_merkle_root,
+    get_branch_indices,
+    get_helper_indices,
+    get_tree_node,
+    verify_merkle_multiproof,
+    verify_merkle_proof,
+)
+from consensus_specs_tpu.utils.ssz.ssz_typing import (
+    Bitlist,
+    ByteVector,
+    Container,
+    List,
+    Union,
+    Vector,
+    uint8,
+    uint64,
+)
+
+Bytes32 = ByteVector[32]
+
+
+class Pair(Container):
+    x: uint64
+    y: Bytes32
+
+
+class Demo(Container):
+    slot: uint64
+    pair: Pair
+    nums: List[uint64, 4096]
+    pairs: List[Pair, 1 << 20]
+    roots: Vector[Bytes32, 64]
+    bits: Bitlist[2048]
+    tag: Union[None, uint64, Pair]
+
+
+def make_demo(rng):
+    return Demo(
+        slot=uint64(rng.randrange(1 << 40)),
+        pair=Pair(x=uint64(7), y=Bytes32(rng.randbytes(32))),
+        nums=List[uint64, 4096]([uint64(rng.randrange(1 << 50)) for _ in range(100)]),
+        pairs=List[Pair, 1 << 20](
+            [Pair(x=uint64(i), y=Bytes32(rng.randbytes(32))) for i in range(33)]
+        ),
+        roots=Vector[Bytes32, 64]([Bytes32(rng.randbytes(32)) for _ in range(64)]),
+        bits=Bitlist[2048]([bool(rng.randrange(2)) for _ in range(700)]),
+        tag=Union[None, uint64, Pair](1, uint64(99)),
+    )
+
+
+def test_single_proof_paths_incl_packed_basics():
+    rng = random.Random(5)
+    d = make_demo(rng)
+    root = d.hash_tree_root()
+    cases = [
+        (("slot",), d.slot.hash_tree_root()),
+        (("pair",), d.pair.hash_tree_root()),
+        (("pair", "y"), d.pair.y.hash_tree_root()),
+        (("pairs", 17), d.pairs[17].hash_tree_root()),
+        (("pairs", 17, "x"), d.pairs[17].x.hash_tree_root()),
+        (("roots", 63), d.roots[63].hash_tree_root()),
+        # packed basic leaves (previously raised NotImplementedError):
+        # the proven leaf is the CHUNK holding the element
+        (("nums", 10), None),
+        (("bits", 300), None),
+        (("nums", "__len__"), len(d.nums).to_bytes(32, "little")),
+    ]
+    for path, leaf in cases:
+        g = get_generalized_index(Demo, *path)
+        if leaf is None:
+            leaf = get_tree_node(d, g)
+        proof = build_proof(d, *path)
+        assert verify_merkle_proof(leaf, proof, g, root), path
+        # tamper detection
+        bad = bytes(32) if bytes(leaf) != bytes(32) else b"\x01" * 32
+        assert not verify_merkle_proof(bad, proof, g, root), path
+
+
+def test_packed_chunk_leaf_contains_element_bytes():
+    rng = random.Random(6)
+    d = make_demo(rng)
+    g = get_generalized_index(Demo, "nums", 10)
+    chunk = get_tree_node(d, g)
+    # uint64 packing: 4 per chunk, element 10 at offset (10 % 4) * 8
+    off = (10 % 4) * 8
+    assert chunk[off : off + 8] == int(d.nums[10]).to_bytes(8, "little")
+
+
+def test_multiproof_round_trip_random_index_sets():
+    rng = random.Random(7)
+    d = make_demo(rng)
+    root = d.hash_tree_root()
+    paths = [
+        ("slot",),
+        ("pair", "x"),
+        ("pair", "y"),
+        ("pairs", 3),
+        ("pairs", 30, "y"),
+        ("roots", 0),
+        ("roots", 31),
+        ("nums", 5),
+        ("bits", 100),
+        ("nums", "__len__"),
+    ]
+    for _ in range(12):
+        k = rng.randrange(1, 6)
+        chosen = rng.sample(paths, k)
+        gindices = [get_generalized_index(Demo, *p) for p in chosen]
+        if len(set(gindices)) != len(gindices):
+            continue  # duplicate target nodes are degenerate
+        leaves, proof = build_multiproof(d, gindices)
+        assert verify_merkle_multiproof(leaves, proof, gindices, root)
+        if proof:
+            tampered = list(proof)
+            tampered[0] = b"\xff" * 32
+            assert not verify_merkle_multiproof(leaves, tampered, gindices, root)
+        if leaves:
+            tampered = list(leaves)
+            tampered[-1] = b"\xfe" * 32
+            assert not verify_merkle_multiproof(tampered, proof, gindices, root)
+
+
+def test_multiproof_shares_helpers_vs_single_proofs():
+    """The point of a multiproof: fewer helper nodes than the sum of the
+    individual branches."""
+    rng = random.Random(8)
+    d = make_demo(rng)
+    gindices = [
+        get_generalized_index(Demo, "roots", 0),
+        get_generalized_index(Demo, "roots", 1),
+        get_generalized_index(Demo, "roots", 2),
+    ]
+    helpers = get_helper_indices(gindices)
+    singles = sum(len(get_branch_indices(g)) for g in gindices)
+    assert len(helpers) < singles
+
+
+def test_single_is_special_case_of_multi():
+    rng = random.Random(9)
+    d = make_demo(rng)
+    root = d.hash_tree_root()
+    g = get_generalized_index(Demo, "pairs", 7)
+    branch = build_proof(d, "pairs", 7)
+    leaves, proof = build_multiproof(d, [g])
+    assert [bytes(b) for b in proof] == [bytes(b) for b in branch]
+    assert leaves == [get_tree_node(d, g)]
+    assert calculate_merkle_root(leaves[0], proof, g) == bytes(root)
+
+
+def test_union_nodes():
+    rng = random.Random(10)
+    d = make_demo(rng)
+    root = d.hash_tree_root()
+    g_tag = get_generalized_index(Demo, "tag")
+    proof = [get_tree_node(d, i) for i in get_branch_indices(g_tag)]
+    assert verify_merkle_proof(d.tag.hash_tree_root(), proof, g_tag, root)
+
+
+def test_light_client_multiproof_over_altair_state():
+    """One multiproof authenticating finalized_checkpoint.root AND
+    next_sync_committee — the two altair sync-protocol commitments
+    (reference specs/altair/sync-protocol.md:67-85 carries them as two
+    separate branches; a multiproof serves both from one witness set)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from consensus_specs_tpu.builder import build_spec_module
+
+    spec = build_spec_module("altair", "minimal")
+    state = spec.BeaconState()
+    state.slot = spec.Slot(1234)
+    state.finalized_checkpoint.epoch = spec.Epoch(9)
+    state.finalized_checkpoint.root = spec.Root(b"\x42" * 32)
+
+    g_fin = get_generalized_index(spec.BeaconState, "finalized_checkpoint", "root")
+    g_sync = get_generalized_index(spec.BeaconState, "next_sync_committee")
+    # the sync-protocol constants (reference specs/altair/sync-protocol.md +
+    # setup.py:476-481): FINALIZED_ROOT_INDEX=105 addresses the checkpoint's
+    # `root` field, NEXT_SYNC_COMMITTEE_INDEX=55 the committee container
+    assert int(g_fin) == 105
+    assert int(g_sync) == 55
+    leaves, proof = build_multiproof(state, [g_fin, g_sync])
+    assert verify_merkle_multiproof(
+        leaves, proof, [g_fin, g_sync], state.hash_tree_root()
+    )
+    assert bytes(leaves[0]) == bytes(state.finalized_checkpoint.root)
+    assert bytes(leaves[1]) == bytes(state.next_sync_committee.hash_tree_root())
