@@ -1,0 +1,161 @@
+"""Cross-chip G1 aggregation-tree reduction (SURVEY §2.7/P2).
+
+The protocol's aggregation trees (committee signatures -> aggregator ->
+block, reference specs/phase0/validator.md:528-601; pubkey aggregation per
+verify, specs/altair/bls.md:33-57) map onto the TPU mesh as a REDUCTION
+over the interconnect: each device folds its local shard of the key set
+with branchless complete additions, then a log2(n)-round XOR butterfly of
+`jax.lax.ppermute` exchanges rides the ICI links — a psum with the G1
+group law as the monoid (XLA's psum only knows scalar monoids, so the
+butterfly spells the tree out; each round is one neighbor exchange + one
+complete add, the same schedule an all-reduce uses).
+
+Point representation: projective (X:Y:Z) Montgomery limb arrays
+(..., 3, NUM_LIMBS); infinity = (0:1:0). The Renes-Costello-Batina
+complete addition (2016, algorithm 7 for a=0, b=4 — the same formula the
+VM's symbolic builder uses, ops/vmlib.py:288) is branchless and
+infinity-safe, so padding lanes and identity folds need no special cases.
+
+Bit-identical to the host oracle's `eth_aggregate_pubkeys` point sum
+(cross-checked in tests/test_mesh_reduce.py and __graft_entry__'s
+dryrun_multichip P2 stage).
+"""
+import functools
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils import bls12_381 as O
+from . import fq
+
+_B3 = 12  # 3*b for y^2 = x^3 + 4
+
+
+def g1_complete_add(p1, p2):
+    """RCB complete projective addition at the jnp level; operands/result
+    are (..., 3, NUM_LIMBS) loose Montgomery limb arrays."""
+    X1, Y1, Z1 = p1[..., 0, :], p1[..., 1, :], p1[..., 2, :]
+    X2, Y2, Z2 = p2[..., 0, :], p2[..., 1, :], p2[..., 2, :]
+    b3 = jnp.asarray(fq.to_mont_int(_B3))
+
+    t0 = fq.mont_mul(X1, X2)
+    t1 = fq.mont_mul(Y1, Y2)
+    t2 = fq.mont_mul(Z1, Z2)
+    t3 = fq.mont_mul(fq.add(X1, Y1), fq.add(X2, Y2))
+    t3 = fq.sub(t3, fq.add(t0, t1))
+    t4 = fq.mont_mul(fq.add(Y1, Z1), fq.add(Y2, Z2))
+    t4 = fq.sub(t4, fq.add(t1, t2))
+    X3 = fq.mont_mul(fq.add(X1, Z1), fq.add(X2, Z2))
+    Y3 = fq.sub(X3, fq.add(t0, t2))
+    X3 = fq.add(t0, t0)
+    t0 = fq.add(X3, t0)
+    t2 = fq.mont_mul(b3, t2)
+    Z3 = fq.add(t1, t2)
+    t1 = fq.sub(t1, t2)
+    Y3 = fq.mont_mul(b3, Y3)
+    X3 = fq.mont_mul(t4, Y3)
+    t2 = fq.mont_mul(t3, t1)
+    X3 = fq.sub(t2, X3)
+    Y3 = fq.mont_mul(Y3, t0)
+    t1 = fq.mont_mul(t1, Z3)
+    Y3 = fq.add(t1, Y3)
+    t0 = fq.mont_mul(t0, t3)
+    Z3 = fq.mont_mul(Z3, t4)
+    Z3 = fq.add(Z3, t0)
+    return jnp.stack([X3, Y3, Z3], axis=-2)
+
+
+def infinity_point(batch_shape=()) -> np.ndarray:
+    out = np.zeros(tuple(batch_shape) + (3, fq.NUM_LIMBS), dtype=np.uint64)
+    out[..., 1, :] = fq.to_mont_int(1)
+    return out
+
+
+def _local_fold(points):
+    """Sequential fold of a device-local (k, 3, L) shard via lax.scan."""
+    # derive the infinity init from the shard so its sharding varyingness
+    # matches the scanned operand under shard_map
+    inf = jnp.zeros_like(points[0])
+    inf = inf.at[..., 1, :].set(jnp.asarray(fq.to_mont_int(1)))
+
+    def body(acc, pt):
+        return g1_complete_add(acc, pt), None
+
+    acc, _ = jax.lax.scan(body, inf, points)
+    return acc
+
+
+def _butterfly_reduce(local, axis_name, n_dev):
+    """XOR butterfly all-reduce with the G1 group law: after log2(n) rounds
+    of ppermute exchanges every device holds the full sum."""
+    step = 1
+    while step < n_dev:
+        perm = [(i, i ^ step) for i in range(n_dev)]
+        recv = jax.lax.ppermute(local, axis_name, perm)
+        local = g1_complete_add(local, recv)
+        step *= 2
+    return local
+
+
+@functools.lru_cache(maxsize=8)
+def _mesh_sum_fn(mesh, n_dev: int):
+    try:
+        from jax import shard_map  # jax >= 0.8
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    axis = mesh.axis_names[0]
+
+    def per_device(pts):  # (k/n, 3, L) local shard
+        local = _local_fold(pts)
+        return _butterfly_reduce(local[None], axis, n_dev)
+
+    return jax.jit(
+        shard_map(
+            per_device,
+            mesh=mesh,
+            in_specs=P(axis),
+            out_specs=P(axis),
+        )
+    )
+
+
+def mesh_aggregate_g1(points: np.ndarray, mesh) -> np.ndarray:
+    """Sum a (k, 3, L) batch of projective G1 points over the mesh's first
+    axis: local fold per device + ICI butterfly. Returns one (3, L) point
+    (device 0's replica)."""
+    n_dev = int(mesh.shape[mesh.axis_names[0]])  # reduction rides axis 0 only
+    assert n_dev & (n_dev - 1) == 0, "mesh axis size must be a power of two"
+    k = points.shape[0]
+    pad = (-k) % n_dev
+    if pad:
+        points = np.concatenate([points, infinity_point((pad,))], axis=0)
+    out = _mesh_sum_fn(mesh, n_dev)(jnp.asarray(points))
+    return np.asarray(out)[0]
+
+
+def aggregate_pubkeys(pubkeys: Sequence[bytes], mesh) -> bytes:
+    """Device-path `eth_aggregate_pubkeys` (reference specs/altair/bls.md:
+    33-57): decode+validate on host, sum on the mesh, re-encode. Raises on
+    invalid/infinity pubkeys exactly like the oracle."""
+    from .bls_backend import _pubkey_limbs
+
+    if len(pubkeys) == 0:
+        raise ValueError("no pubkeys to aggregate")
+    pts = np.zeros((len(pubkeys), 3, fq.NUM_LIMBS), dtype=np.uint64)
+    one = fq.to_mont_int(1)
+    for i, pk in enumerate(pubkeys):
+        x, y = _pubkey_limbs(bytes(pk))
+        pts[i, 0], pts[i, 1], pts[i, 2] = x, y, one
+    agg = mesh_aggregate_g1(pts, mesh)
+    x, y, z = (fq.from_mont_limbs(agg[i]) for i in range(3))
+    if z == 0:
+        # e.g. [P, -P]: the oracle encodes the infinity aggregate rather
+        # than raising (utils/bls12_381.py g1_to_bytes(None))
+        return O.g1_to_bytes(None)
+    zinv = pow(z, -1, O.P)
+    aff = (O.Fq(x * zinv % O.P), O.Fq(y * zinv % O.P))
+    return O.g1_to_bytes(aff)

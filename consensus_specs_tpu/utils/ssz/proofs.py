@@ -194,9 +194,8 @@ def _tree_interior_node(tree: _ChunkTree, height: int, idx: int) -> bytes:
     return node
 
 
-def _subtree_node(chunks: PyList[bytes], depth: int, height: int, idx: int) -> bytes:
-    """Node at (height, idx) over an explicit chunk list of a depth-``depth``
-    zero-padded subtree."""
+def _subtree_node(chunks: PyList[bytes], height: int, idx: int) -> bytes:
+    """Node at (height, idx) over an explicit zero-padded chunk list."""
     if height == 0:
         return chunks[idx] if idx < len(chunks) else b"\x00" * 32
     width = 1 << height
@@ -257,7 +256,7 @@ def _descend_data(view: View, bits: str) -> bytes:
         if tree is not None:
             return _tree_interior_node(tree, height, idx)
         chunks, _ = _chunk_layer(view)
-        return _subtree_node(chunks, depth, height, idx)
+        return _subtree_node(chunks, height, idx)
     chunk_bits, rest = bits[:depth], bits[depth:]
     ci = int(chunk_bits, 2) if chunk_bits else 0
     if not rest:
