@@ -8,7 +8,8 @@ GENERATORS = operations sanity epoch_processing rewards finality forks transitio
              fork_choice ssz_static ssz_generic shuffling bls genesis merkle
 
 .PHONY: test citest test_tpu_backend lint generate_tests \
-        detect_generator_incomplete bench multichip clean_vectors
+        detect_generator_incomplete bench multichip clean_vectors \
+        generate_random_tests
 
 # fast default: BLS stubbed except @always_bls (reference `make test`)
 test:
@@ -34,6 +35,11 @@ generate_tests:
 		JAX_PLATFORMS=cpu python -m consensus_specs_tpu.gen.generators.$$g \
 			-o $(VECTORS_DIR) || exit 1; \
 	done
+
+# regenerate the code-generated random scenario-matrix test modules
+# (reference `make -C tests/generators/random`)
+generate_random_tests:
+	python tools/gen_random_tests.py
 
 detect_generator_incomplete:
 	python -c "from consensus_specs_tpu.gen.gen_runner import detect_incomplete; \

@@ -158,3 +158,178 @@ def test_mixed_scores_follow_exact_rule(spec, state):
         if not in_leak:
             score -= min(rate, score)
         assert int(state.inactivity_scores[index]) == score
+
+
+# -- (scores x participation x leak) matrix cells ----------------------------
+# Exact-value oracle: expected scores are recomputed per validator from the
+# update rule (reference specs/altair/beacon-chain.md:607-622) using the
+# state BEFORE the handler runs; every cell asserts all scores, not samples.
+
+from random import Random
+
+from ...context import spec_test, with_custom_state
+from ...context import misc_balances
+
+
+def _expected_scores(spec, state):
+    eligible = set(spec.get_eligible_validator_indices(state))
+    timely = spec.get_unslashed_participating_indices(
+        state, spec.TIMELY_TARGET_FLAG_INDEX, spec.get_previous_epoch(state)
+    )
+    bias = int(spec.config.INACTIVITY_SCORE_BIAS)
+    rate = int(spec.config.INACTIVITY_SCORE_RECOVERY_RATE)
+    leaking = spec.is_in_inactivity_leak(state)
+    out = []
+    for i, s in enumerate(state.inactivity_scores):
+        s = int(s)
+        if i in eligible:
+            if i in timely:
+                s -= min(1, s)
+            else:
+                s += bias
+            if not leaking:
+                s -= min(rate, s)
+        out.append(s)
+    return out
+
+
+def _seed_scores(spec, state, kind, rng):
+    n = len(state.validators)
+    if kind == "zero":
+        state.inactivity_scores = [spec.uint64(0)] * n
+    else:
+        state.inactivity_scores = [
+            spec.uint64(rng.randrange(0, 100)) for _ in range(n)
+        ]
+
+
+def _seed_participation(spec, state, kind, rng):
+    n = len(state.validators)
+    if kind == "empty":
+        flags = [0] * n
+    elif kind == "full":
+        full = 0
+        for f in (spec.TIMELY_SOURCE_FLAG_INDEX, spec.TIMELY_TARGET_FLAG_INDEX,
+                  spec.TIMELY_HEAD_FLAG_INDEX):
+            full |= 1 << int(f)
+        flags = [full] * n
+    else:
+        flags = [rng.randrange(8) for _ in range(n)]
+    state.previous_epoch_participation = [spec.ParticipationFlags(f) for f in flags]
+
+
+def _run_matrix_cell(spec, state, scores, participation, leaking, seed):
+    rng = Random(seed)
+    if leaking:
+        _set_leaking(spec, state)
+    else:
+        next_epoch(spec, state)
+        next_epoch(spec, state)
+    _seed_scores(spec, state, scores, rng)
+    _seed_participation(spec, state, participation, rng)
+    expected = _expected_scores(spec, state)
+    yield from run_epoch_processing_with(spec, state, 'process_inactivity_updates')
+    assert [int(s) for s in state.inactivity_scores] == expected
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+def test_zero_scores_empty_participation(spec, state):
+    yield from _run_matrix_cell(spec, state, "zero", "empty", False, 100)
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+def test_zero_scores_empty_participation_leaking(spec, state):
+    yield from _run_matrix_cell(spec, state, "zero", "empty", True, 101)
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+def test_zero_scores_random_participation(spec, state):
+    yield from _run_matrix_cell(spec, state, "zero", "random", False, 102)
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+def test_zero_scores_random_participation_leaking(spec, state):
+    yield from _run_matrix_cell(spec, state, "zero", "random", True, 103)
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+def test_zero_scores_full_participation_leaking(spec, state):
+    yield from _run_matrix_cell(spec, state, "zero", "full", True, 104)
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+def test_random_scores_empty_participation(spec, state):
+    yield from _run_matrix_cell(spec, state, "random", "empty", False, 105)
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+def test_random_scores_empty_participation_leaking(spec, state):
+    yield from _run_matrix_cell(spec, state, "random", "empty", True, 106)
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+def test_random_scores_random_participation(spec, state):
+    yield from _run_matrix_cell(spec, state, "random", "random", False, 107)
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+def test_random_scores_random_participation_leaking(spec, state):
+    yield from _run_matrix_cell(spec, state, "random", "random", True, 108)
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+def test_random_scores_full_participation(spec, state):
+    yield from _run_matrix_cell(spec, state, "random", "full", False, 109)
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+def test_random_scores_full_participation_leaking(spec, state):
+    yield from _run_matrix_cell(spec, state, "random", "full", True, 110)
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+def test_some_slashed_random_participation_leaking(spec, state):
+    rng = Random(111)
+    _set_leaking(spec, state)
+    for i in range(0, len(state.validators), 3):
+        state.validators[i].slashed = True
+    _seed_scores(spec, state, "random", rng)
+    _seed_participation(spec, state, "random", rng)
+    expected = _expected_scores(spec, state)
+    yield from run_epoch_processing_with(spec, state, 'process_inactivity_updates')
+    assert [int(s) for s in state.inactivity_scores] == expected
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+def test_some_exited_random_participation_leaking(spec, state):
+    rng = Random(112)
+    _set_leaking(spec, state)
+    cur = spec.get_current_epoch(state)
+    for i in range(0, len(state.validators), 4):
+        state.validators[i].exit_epoch = cur  # no longer active next epoch
+        state.validators[i].withdrawable_epoch = cur + 10
+    _seed_scores(spec, state, "random", rng)
+    _seed_participation(spec, state, "random", rng)
+    expected = _expected_scores(spec, state)
+    yield from run_epoch_processing_with(spec, state, 'process_inactivity_updates')
+    assert [int(s) for s in state.inactivity_scores] == expected
+
+
+@with_phases([ALTAIR])
+@spec_test
+@with_custom_state(balances_fn=misc_balances, threshold_fn=lambda spec: spec.config.EJECTION_BALANCE)
+def test_misc_balances_random_matrix_cell(spec, state):
+    yield from _run_matrix_cell(spec, state, "random", "random", False, 113)

@@ -39,3 +39,57 @@ def test_sync_committees_no_progress_mid_period(spec, state):
 
     assert state.current_sync_committee == pre_current
     assert state.next_sync_committee == pre_next
+
+
+@with_phases([ALTAIR])
+@with_presets([MINIMAL], reason="period transition needs few epochs only on minimal")
+@spec_state_test
+def test_sync_committees_rotate_after_registry_churn(spec, state):
+    # exits + balance churn between the committees' computation and the
+    # period boundary: the NEW next committee is computed from the mutated
+    # registry, while current inherits the pre-computed next unchanged
+    period_epochs = int(spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD)
+    transition_to(spec, state, (period_epochs - 1) * spec.SLOTS_PER_EPOCH)
+    cur_epoch = spec.get_current_epoch(state)
+    for i in range(0, len(state.validators), 5):
+        state.validators[i].effective_balance = spec.EFFECTIVE_BALANCE_INCREMENT
+    state.validators[1].exit_epoch = cur_epoch + 1
+    pre_next = state.next_sync_committee.copy()
+
+    yield from run_epoch_processing_with(spec, state, 'process_sync_committee_updates')
+
+    assert state.current_sync_committee == pre_next
+    assert state.next_sync_committee == spec.get_next_sync_committee(state)
+
+
+@with_phases([ALTAIR])
+@with_presets([MINIMAL], reason="period transition needs few epochs only on minimal")
+@spec_state_test
+def test_sync_committees_stable_through_consecutive_boundaries(spec, state):
+    # two consecutive period boundaries: each rotation promotes the
+    # previously-computed next committee exactly once
+    period_epochs = int(spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD)
+    transition_to(spec, state, (period_epochs - 1) * spec.SLOTS_PER_EPOCH)
+    first_next = state.next_sync_committee.copy()
+    yield from run_epoch_processing_with(spec, state, 'process_sync_committee_updates')
+    assert state.current_sync_committee == first_next
+    second_next = state.next_sync_committee.copy()
+
+    # advance one more full period and run the pass again directly
+    transition_to(spec, state, (2 * period_epochs - 1) * spec.SLOTS_PER_EPOCH)
+    spec.process_sync_committee_updates(state)
+    assert state.current_sync_committee == second_next
+    assert state.next_sync_committee == spec.get_next_sync_committee(state)
+
+
+@with_phases([ALTAIR])
+@with_presets([MINIMAL], reason="period transition needs few epochs only on minimal")
+@spec_state_test
+def test_sync_committees_aggregate_pubkey_consistent(spec, state):
+    # the promoted committee's precomputed aggregate_pubkey must equal the
+    # aggregate of its member pubkeys (specs/altair/beacon-chain.md:279-293)
+    period_epochs = int(spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD)
+    transition_to(spec, state, (period_epochs - 1) * spec.SLOTS_PER_EPOCH)
+    yield from run_epoch_processing_with(spec, state, 'process_sync_committee_updates')
+    agg = spec.eth_aggregate_pubkeys(list(state.current_sync_committee.pubkeys))
+    assert agg == state.current_sync_committee.aggregate_pubkey

@@ -129,3 +129,89 @@ def test_upgrade_mid_epoch_slot(spec, state, phases):
     post = _upgrade(phases, state)
     yield 'post', post
     assert post.slot == state.slot
+
+
+# -- randomized pre-state upgrades (role parity with the reference's
+#    altair fork random suite: seeded registry/balance/attestation shapes
+#    pushed through upgrade_to_altair, invariants checked by _upgrade) ------
+
+from random import Random
+
+
+def _randomized_upgrade(spec, state, phases, seed, with_attestations=False,
+                        leaking=False):
+    rng = Random(seed)
+    next_epoch(spec, state)
+    if leaking:
+        from ...helpers.state import advance_into_leak
+
+        advance_into_leak(spec, state)
+    if with_attestations:
+        _, _, state = next_epoch_with_attestations(spec, state, True, True)
+    randomize_registry_for_upgrade(spec, state, seed)
+    # random balances too (registry randomizer touches flags/exits)
+    for i in range(0, len(state.validators), 3):
+        state.balances[i] = spec.Gwei(rng.randrange(int(spec.MAX_EFFECTIVE_BALANCE * 2)))
+    yield 'pre', state
+    post = _upgrade(phases, state)
+    yield 'post', post
+    return post
+
+
+@with_phases([PHASE0], other_phases=[ALTAIR])
+@spec_state_test
+def test_upgrade_random_seed_1(spec, state, phases):
+    yield from _randomized_upgrade(spec, state, phases, seed=2101)
+
+
+@with_phases([PHASE0], other_phases=[ALTAIR])
+@spec_state_test
+def test_upgrade_random_seed_2(spec, state, phases):
+    yield from _randomized_upgrade(spec, state, phases, seed=2102)
+
+
+@with_phases([PHASE0], other_phases=[ALTAIR])
+@spec_state_test
+def test_upgrade_random_with_attestations_seed_3(spec, state, phases):
+    yield from _randomized_upgrade(
+        spec, state, phases, seed=2103, with_attestations=True
+    )
+
+
+@with_phases([PHASE0], other_phases=[ALTAIR])
+@spec_state_test
+def test_upgrade_random_with_attestations_seed_4(spec, state, phases):
+    yield from _randomized_upgrade(
+        spec, state, phases, seed=2104, with_attestations=True
+    )
+
+
+@with_phases([PHASE0], other_phases=[ALTAIR])
+@spec_state_test
+def test_upgrade_random_while_leaking(spec, state, phases):
+    yield from _randomized_upgrade(spec, state, phases, seed=2105, leaking=True)
+
+
+@with_phases([PHASE0], other_phases=[ALTAIR])
+@spec_state_test
+def test_upgrade_random_large_validator_churn(spec, state, phases):
+    rng = Random(2106)
+    next_epoch(spec, state)
+    cur = spec.get_current_epoch(state)
+    # heavy churn: a third exited, some slashed, some pending withdrawal
+    for i in range(len(state.validators)):
+        roll = rng.random()
+        v = state.validators[i]
+        if roll < 0.2:
+            v.exit_epoch = cur + rng.randrange(1, 8)
+        elif roll < 0.3:
+            v.slashed = True
+            v.exit_epoch = cur
+            v.withdrawable_epoch = cur + 16
+    yield 'pre', state
+    post = _upgrade(phases, state)
+    # churn flags survive the schema migration untouched
+    for i in range(len(state.validators)):
+        assert post.validators[i].slashed == state.validators[i].slashed
+        assert post.validators[i].exit_epoch == state.validators[i].exit_epoch
+    yield 'post', post

@@ -33,10 +33,9 @@ def _randomize_scores(spec, state, rng, high=False, half_zero=False):
 
 
 def _leaking_state(spec, state):
-    for _ in range(int(spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY) + 3):
-        next_epoch(spec, state)
-    assert spec.is_in_inactivity_leak(state)
-    return state
+    from ...helpers.state import advance_into_leak
+
+    return advance_into_leak(spec, state, extra_epochs=1)
 
 
 @with_phases(_ALTAIR_ON)
@@ -107,4 +106,68 @@ def test_half_zero_inactivity_scores_leaking_with_participation(spec, state):
 def test_zero_scores_no_inactivity_penalties(spec, state):
     state = _attested_state(spec, state)
     state.inactivity_scores = [spec.uint64(0)] * len(state.validators)
+    yield from run_deltas(spec, state)
+
+
+@with_phases(_ALTAIR_ON)
+@spec_state_test
+def test_random_inactivity_scores_partial_participation(spec, state):
+    # only ~40% of each committee attests: deltas must remain component-exact
+    rng = Random(60111)
+    state = _attested_state(
+        spec, state,
+        participation_fn=lambda slot, idx, comm: (
+            set(v for v in comm if rng.random() < 0.4)
+            or {sorted(comm)[0]}  # never empty: an unsigned empty attestation is invalid
+        ),
+    )
+    _randomize_scores(spec, state, Random(60112))
+    yield from run_deltas(spec, state)
+
+
+@with_phases(_ALTAIR_ON)
+@spec_state_test
+def test_random_inactivity_scores_partial_participation_leaking(spec, state):
+    rng = Random(60221)
+    _leaking_state(spec, state)
+    _, _, state = next_epoch_with_attestations(
+        spec, state, False, True,
+        participation_fn=lambda slot, idx, comm: (
+            set(v for v in comm if rng.random() < 0.4)
+            or {sorted(comm)[0]}
+        ),
+    )
+    _randomize_scores(spec, state, Random(60222))
+    assert spec.is_in_inactivity_leak(state)
+    yield from run_deltas(spec, state)
+
+
+@with_phases(_ALTAIR_ON)
+@spec_state_test
+def test_banded_inactivity_scores_with_slashings(spec, state):
+    # score bands (0 / small / huge) crossed with a slashed stripe
+    state = _attested_state(spec, state)
+    n = len(state.validators)
+    state.inactivity_scores = [
+        spec.uint64([0, 7, 10_000_000][i % 3]) for i in range(n)
+    ]
+    for i in range(0, n, 7):
+        state.validators[i].slashed = True
+    yield from run_deltas(spec, state)
+
+
+@with_phases(_ALTAIR_ON)
+@spec_state_test
+def test_extreme_inactivity_scores_leaking(spec, state):
+    # u64-scale scores during a leak: the quotient arithmetic must not
+    # overflow or round differently from the component-exact engine
+    _leaking_state(spec, state)
+    _, _, state = next_epoch_with_attestations(spec, state, False, True)
+    n = len(state.validators)
+    # largest scores whose penalty numerator (effective_balance * score)
+    # still fits uint64 — the spec's checked arithmetic rejects beyond
+    state.inactivity_scores = [
+        spec.uint64((1 << 28) + i * (1 << 10)) for i in range(n)
+    ]
+    assert spec.is_in_inactivity_leak(state)
     yield from run_deltas(spec, state)

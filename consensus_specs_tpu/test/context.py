@@ -104,8 +104,11 @@ def default_balances(spec):
 
 
 def scaled_churn_balances(spec):
-    """Validator set large enough for a churn limit above MIN_PER_EPOCH_CHURN_LIMIT."""
-    num_validators = spec.config.MIN_PER_EPOCH_CHURN_LIMIT * (2 + spec.config.CHURN_LIMIT_QUOTIENT)
+    """Validator set large enough for a churn limit ABOVE
+    MIN_PER_EPOCH_CHURN_LIMIT: active_count // CHURN_LIMIT_QUOTIENT must
+    exceed the minimum, so the count scales by the QUOTIENT (the +2 lands
+    firmly past the boundary)."""
+    num_validators = spec.config.CHURN_LIMIT_QUOTIENT * (2 + spec.config.MIN_PER_EPOCH_CHURN_LIMIT)
     return [spec.MAX_EFFECTIVE_BALANCE] * int(num_validators)
 
 

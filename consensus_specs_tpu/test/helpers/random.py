@@ -127,6 +127,22 @@ def random_block(spec, state, rng, exited: set):
             index = rng.choice(eligible)
             block.body.voluntary_exits = prepare_signed_exits(spec, state, [index])
             exited.add(index)
+    # altair+: random sync-committee participation, signed over the parent
+    # root the block actually carries (cycling density per block). Built
+    # from a forwarded state so period-boundary committee rotations are
+    # honored.
+    if is_post_altair(spec):
+        from .sync_committee import build_sync_aggregate
+
+        density = rng.choice([0.0, 0.25, 0.7, 1.0])
+        bits = [rng.random() < density for _ in range(int(spec.SYNC_COMMITTEE_SIZE))]
+        at_slot = state
+        if state.slot < block.slot:
+            at_slot = state.copy()
+            spec.process_slots(at_slot, block.slot)
+        block.body.sync_aggregate = build_sync_aggregate(
+            spec, at_slot, bits, slot=block.slot, block_root=block.parent_root
+        )
     return block
 
 

@@ -63,3 +63,12 @@ def state_transition_and_sign_block(spec, state, block, expect_fail=False):
     transition_unsigned_block(spec, state, block)
     block.state_root = spec.hash_tree_root(state)
     return sign_block(spec, state, block)
+
+
+def advance_into_leak(spec, state, extra_epochs=0):
+    """Advance empty epochs until the inactivity leak is active
+    (MIN_EPOCHS_TO_INACTIVITY_PENALTY + 2 + extra), asserting it engaged."""
+    for _ in range(int(spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY) + 2 + extra_epochs):
+        next_epoch(spec, state)
+    assert spec.is_in_inactivity_leak(state)
+    return state

@@ -387,3 +387,126 @@ def test_empty_participants_zeroed_sig(spec, state):
     attestation.signature = spec.BLSSignature()
     # zero participants: indexed attestation has no attesters -> invalid
     yield from run_attestation_processing(spec, state, attestation, valid=False)
+
+
+# -- round-4 additions: full-epoch inclusion delays, source-root edge
+#    cases, and nonzero-index slot variants ---------------------------------
+
+
+def _aged_attestation(spec, state, mutator=None):
+    """A signed attestation included exactly SLOTS_PER_EPOCH after its
+    slot — the maximum inclusion distance that is still valid."""
+    attestation = get_valid_attestation(spec, state, signed=False)
+    if mutator is not None:
+        mutator(attestation)
+    sign_attestation(spec, state, attestation)
+    next_slots(spec, state, spec.SLOTS_PER_EPOCH)
+    return attestation
+
+
+@with_all_phases
+@spec_state_test
+def test_correct_after_epoch_delay(spec, state):
+    next_epoch(spec, state)  # leave the genesis epoch first
+    attestation = _aged_attestation(spec, state)
+    yield from run_attestation_processing(spec, state, attestation)
+
+
+@with_all_phases
+@spec_state_test
+def test_incorrect_head_after_epoch_delay(spec, state):
+    next_epoch(spec, state)
+
+    def bad_head(att):
+        att.data.beacon_block_root = b"\x37" * 32
+
+    attestation = _aged_attestation(spec, state, bad_head)
+    yield from run_attestation_processing(spec, state, attestation)
+
+
+@with_all_phases
+@spec_state_test
+def test_incorrect_target_after_epoch_delay(spec, state):
+    next_epoch(spec, state)
+
+    def bad_target(att):
+        att.data.target.root = b"\x38" * 32
+
+    attestation = _aged_attestation(spec, state, bad_target)
+    yield from run_attestation_processing(spec, state, attestation)
+
+
+@with_all_phases
+@spec_state_test
+def test_incorrect_head_and_target_after_epoch_delay(spec, state):
+    next_epoch(spec, state)
+
+    def bad_both(att):
+        att.data.beacon_block_root = b"\x39" * 32
+        att.data.target.root = b"\x3a" * 32
+
+    attestation = _aged_attestation(spec, state, bad_both)
+    yield from run_attestation_processing(spec, state, attestation)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_previous_source_root(spec, state):
+    # previous-epoch vote whose source ROOT disagrees with the state's
+    # previous justified checkpoint (epoch matches) -> rejected
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    attestation = get_valid_attestation(
+        spec, state, slot=state.slot - spec.SLOTS_PER_EPOCH, signed=False
+    )
+    assert attestation.data.target.epoch == spec.get_previous_epoch(state)
+    attestation.data.source.root = b"\x45" * 32
+    sign_attestation(spec, state, attestation)
+    yield from run_attestation_processing(spec, state, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_source_root_is_target_root(spec, state):
+    # degenerate-but-legal vote shape where source.root happens to equal
+    # target.root (self-referential chains near genesis)
+    attestation = get_valid_attestation(spec, state, signed=False)
+    attestation.data.source.root = attestation.data.target.root
+    # only valid if the justified checkpoint root actually matches
+    if attestation.data.source.root != state.current_justified_checkpoint.root:
+        sign_attestation(spec, state, attestation)
+        next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+        yield from run_attestation_processing(spec, state, attestation, valid=False)
+    else:
+        sign_attestation(spec, state, attestation)
+        next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+        yield from run_attestation_processing(spec, state, attestation)
+
+
+@with_all_phases
+@spec_state_test
+def test_wrong_index_for_slot_0(spec, state):
+    # index >= committee count for the slot -> rejected
+    committee_count = spec.get_committee_count_per_slot(
+        state, spec.get_current_epoch(state)
+    )
+    attestation = get_valid_attestation(spec, state, signed=True)
+    attestation.data.index = committee_count  # one past the last
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_wrong_index_for_slot_1(spec, state):
+    committee_count = spec.get_committee_count_per_slot(
+        state, spec.get_current_epoch(state)
+    )
+    attestation = get_valid_attestation(spec, state, signed=True)
+    attestation.data.index = spec.MAX_COMMITTEES_PER_SLOT - 1
+    if committee_count > spec.MAX_COMMITTEES_PER_SLOT - 1:
+        import pytest
+
+        pytest.skip("every index is in range on this preset")
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation, valid=False)

@@ -238,3 +238,49 @@ def test_invalid_sig_other_fork_version(spec, state):
     yield from run_deposit_processing(
         spec, state, deposit, validator_index, effective=False
     )
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_valid_sig_but_forked_state(spec, state):
+    # deposits pin GENESIS_FORK_VERSION in their signing domain: a state
+    # whose fork has moved on must STILL accept a genesis-version signature
+    # (compute_domain with no fork_version default, reference
+    # specs/phase0/beacon-chain.md:1871-1887)
+    state.fork.current_version = spec.Version(b'\x07\x07\x07\x07')
+    validator_index = len(state.validators)
+    amount = spec.MAX_EFFECTIVE_BALANCE
+    deposit = prepare_state_and_deposit(
+        spec, state, validator_index, amount, signed=True
+    )
+    yield from run_deposit_processing(spec, state, deposit, validator_index)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_sig_current_version_on_forked_state(spec, state):
+    # the converse: signing under the state's CURRENT (non-genesis) version
+    # is an invalid proof of possession even though the state carries that
+    # very version
+    state.fork.current_version = spec.Version(b'\x07\x07\x07\x07')
+    validator_index = len(state.validators)
+    amount = spec.MAX_EFFECTIVE_BALANCE
+    deposit = prepare_state_and_deposit(spec, state, validator_index, amount, signed=False)
+    domain = spec.compute_domain(
+        spec.DOMAIN_DEPOSIT, fork_version=state.fork.current_version
+    )
+    signing_root = spec.compute_signing_root(
+        spec.DepositMessage(
+            pubkey=deposit.data.pubkey,
+            withdrawal_credentials=deposit.data.withdrawal_credentials,
+            amount=deposit.data.amount,
+        ),
+        domain,
+    )
+    deposit.data.signature = spec.bls.Sign(privkeys[validator_index], signing_root)
+    _, state.eth1_data.deposit_root = build_deposit_tree_and_root(spec, [deposit.data])
+    yield from run_deposit_processing(
+        spec, state, deposit, validator_index, effective=False
+    )

@@ -239,3 +239,124 @@ def test_uneven_effective_balances(spec, state):
             - steps * int(spec.EFFECTIVE_BALANCE_INCREMENT) // 2
         ) // int(spec.EFFECTIVE_BALANCE_INCREMENT) * int(spec.EFFECTIVE_BALANCE_INCREMENT)
     yield from run_deltas(spec, state)
+
+
+# -- round-4 additions: wrong-field vote shapes, duplicate participation,
+#    activation/exit mixes, leak-duration bands, and tiny-balance edges ----
+
+
+def _leaking_state(spec, state, extra_epochs=0):
+    from ...helpers.state import advance_into_leak
+
+    return advance_into_leak(spec, state, extra_epochs)
+
+
+@with_all_phases
+@spec_state_test
+def test_genesis_epoch_full_attestations_no_deltas_engine(spec, state):
+    # during the genesis epoch there is no previous epoch to account: the
+    # engine must report all-zero previous-epoch deltas even with REAL
+    # current-epoch votes recorded in the state
+    from ...helpers.attestations import next_slots_with_attestations
+
+    assert spec.get_current_epoch(state) == spec.GENESIS_EPOCH
+    _, _, state = next_slots_with_attestations(
+        spec, state, int(spec.SLOTS_PER_EPOCH) - 2, True, False
+    )
+    assert spec.get_current_epoch(state) == spec.GENESIS_EPOCH
+    if hasattr(state, "current_epoch_attestations"):
+        assert len(state.current_epoch_attestations) > 0
+    yield from run_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_one_validator_one_gwei_effective(spec, state):
+    # the smallest nonzero effective balance: per-increment arithmetic
+    # (base reward scales with sqrt of total balance) must stay exact
+    state = _attested_state(spec, state)
+    state.validators[3].effective_balance = spec.EFFECTIVE_BALANCE_INCREMENT
+    yield from run_deltas_at_boundary(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_all_balances_below_increment(spec, state):
+    # every effective balance at the minimum increment: rewards nearly
+    # vanish but eligibility rules still apply
+    state = _attested_state(spec, state)
+    for v in state.validators:
+        v.effective_balance = spec.EFFECTIVE_BALANCE_INCREMENT
+    yield from run_deltas_at_boundary(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_not_yet_activated_validators_no_deltas(spec, state):
+    # pending validators are ineligible: zero deltas for them. The pending
+    # stripe is carved out BEFORE the attesting epoch so recorded committee
+    # shapes stay consistent with the registry.
+    future = spec.Epoch(10)
+    for i in range(0, len(state.validators), 6):
+        state.validators[i].activation_epoch = future
+    state = _attested_state(spec, state)
+    assert spec.get_current_epoch(state) < future
+    yield from run_deltas_at_boundary(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_withdrawable_slashed_validators(spec, state):
+    # slashed AND already withdrawable: drops out of the eligible set
+    state = _attested_state(spec, state)
+    cur = spec.get_current_epoch(state)
+    for i in range(0, len(state.validators), 5):
+        state.validators[i].slashed = True
+        state.validators[i].withdrawable_epoch = cur
+    yield from run_deltas_at_boundary(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_seven_epoch_leak(spec, state):
+    _leaking_state(spec, state, extra_epochs=2)
+    yield from run_deltas_at_boundary(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_ten_epoch_leak(spec, state):
+    _leaking_state(spec, state, extra_epochs=5)
+    yield from run_deltas_at_boundary(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_leak_with_full_participation(spec, state):
+    # a leak epoch where everyone nonetheless attests: participants are
+    # made whole (phase0: rewards cancel) while nobody else is
+    _leaking_state(spec, state)
+    _, _, state = next_epoch_with_attestations(spec, state, False, True)
+    assert spec.is_in_inactivity_leak(state)
+    yield from run_deltas_at_boundary(spec, state)
+
+
+@with_all_phases
+@spec_test
+@with_custom_state(low_balances, zero_activation_threshold)
+def test_leak_low_balances(spec, state):
+    _leaking_state(spec, state)
+    yield from run_deltas_at_boundary(spec, state)
+
+
+@with_all_phases
+@spec_test
+@with_custom_state(misc_balances, default_activation_threshold)
+def test_random_attestations_misc_balances(spec, state):
+    rng = Random(90210)
+
+    def sample(slot, index, committee):
+        return set(v for v in committee if rng.random() < 0.6) or {sorted(committee)[0]}
+
+    state = _attested_state(spec, state, participation_fn=sample)
+    yield from run_deltas_at_boundary(spec, state)

@@ -1,0 +1,1 @@
+from consensus_specs_tpu.test.altair.block_processing.test_process_sync_aggregate_random import *  # noqa: F401,F403

@@ -1,0 +1,1 @@
+from consensus_specs_tpu.test.altair.random.test_random_matrix import *  # noqa: F401,F403
