@@ -24,10 +24,13 @@ citest:
 test_tpu_backend:
 	$(PYTEST) tests/phase0 -q --run-slow --bls-type=tpu
 
-# syntax/bytecode sweep (flake8/mypy are not in this image; compileall
-# catches syntax errors and the test run is the real gate)
+# static gate: compileall (syntax) + speclint (undefined names, unused
+# imports, and the built-spec namespace/annotation checks — the role the
+# reference fills with flake8 + strict mypy over its generated spec,
+# reference Makefile:133-136; neither tool ships in this image)
 lint:
 	python -m compileall -q consensus_specs_tpu tests bench.py __graft_entry__.py
+	JAX_PLATFORMS=cpu python tools/speclint.py
 
 # emit every cross-client vector suite (reference `make generate_tests`)
 generate_tests:

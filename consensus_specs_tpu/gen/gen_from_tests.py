@@ -9,7 +9,6 @@ import inspect
 from importlib import import_module
 from typing import Dict, Iterable
 
-from ..test import context
 from .gen_typing import TestCase, TestProvider
 
 

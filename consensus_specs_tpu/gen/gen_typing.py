@@ -1,6 +1,6 @@
 """Test-vector generator typing
 (reference: gen_helpers/gen_base/gen_typing.py:16-35)."""
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Callable, Iterable, List, Tuple
 
 # a case function returns a list of (name, kind, value) parts;

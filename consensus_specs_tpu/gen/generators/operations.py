@@ -16,9 +16,10 @@ PHASE0_MODS = {
     "randao": f"{_T}.phase0.block_processing.test_process_randao",
     "voluntary_exit": f"{_T}.phase0.block_processing.test_process_voluntary_exit",
 }
-ALTAIR_MODS = combine_mods(PHASE0_MODS, {
-    "sync_aggregate": f"{_T}.altair.block_processing.test_process_sync_aggregate",
-})
+ALTAIR_MODS = combine_mods(PHASE0_MODS, combine_mods(
+    {"sync_aggregate": f"{_T}.altair.block_processing.test_process_sync_aggregate"},
+    {"sync_aggregate": f"{_T}.altair.block_processing.test_process_sync_aggregate_random"},
+))
 MERGE_MODS = combine_mods(ALTAIR_MODS, {
     "execution_payload": f"{_T}.merge.block_processing.test_process_execution_payload",
 })

@@ -27,10 +27,8 @@ Correctness is cross-checked against the oracle in tests/test_ops_pairing.py.
 """
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from ..utils.bls12_381 import P, R, X_PARAM
-from . import fq
 from . import towers as tw
 from .curve import FQ2_OPS, double, point, point_select
 

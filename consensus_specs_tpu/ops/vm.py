@@ -23,8 +23,8 @@ Register values are loose Montgomery residues (ops.fq conventions). The
 assembler tracks magnitude bounds per value and auto-inserts compress
 multiplies, so lazy reduction is handled statically at assembly time.
 """
-from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Tuple
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp

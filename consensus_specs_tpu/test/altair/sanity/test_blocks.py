@@ -41,7 +41,6 @@ def test_block_with_full_sync_aggregate(spec, state):
 @always_bls
 def test_block_with_wrong_root_sync_aggregate_rejected(spec, state):
     from ...context import expect_assertion_error
-    from ...helpers.block import sign_block
 
     block = build_empty_block_for_next_slot(spec, state)
     bits = [True] * int(spec.SYNC_COMMITTEE_SIZE)
@@ -80,7 +79,7 @@ def test_multiple_empty_epochs(spec, state):
 @spec_state_test
 def test_block_with_attestation_and_exit_mix(spec, state):
     from ...helpers.attestations import get_valid_attestation
-    from ...helpers.state import next_epoch, next_slot, transition_to
+    from ...helpers.state import next_epoch, next_slot
     from ...helpers.voluntary_exits import prepare_signed_exits
 
     # age the validators past the exit-eligibility threshold

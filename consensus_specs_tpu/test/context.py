@@ -18,7 +18,7 @@ they are collected for the vector writers (gen system).
 import inspect
 from random import Random
 
-from ..builder import FORK_ORDER, Configuration, build_spec_module
+from ..builder import build_spec_module
 from ..utils import bls
 
 PHASE0 = "phase0"

@@ -1,5 +1,5 @@
 """Attester-slashing helpers (reference: test/helpers/attester_slashings.py)."""
-from .attestations import get_valid_attestation, sign_attestation, sign_indexed_attestation
+from .attestations import get_valid_attestation, sign_attestation
 
 
 def get_valid_attester_slashing(spec, state, slot=None, index=None, signed_1=False, signed_2=False):

@@ -3,14 +3,11 @@
 
 Provenance: adapted from the reference's test/phase0/block_processing/test_process_attestation.py — scenario code and comments largely follow the reference test suite (round-1 port); newer suites in this repo are original.
 """
-from ...context import (
-    always_bls, expect_assertion_error, never_bls, spec_state_test,
-    with_all_phases,
-)
+from ...context import always_bls, never_bls, spec_state_test, with_all_phases
 from ...helpers.attestations import (
     get_valid_attestation, run_attestation_processing, sign_attestation,
 )
-from ...helpers.state import next_epoch, next_slot, next_slots, transition_to
+from ...helpers.state import next_epoch, next_slots
 
 
 @with_all_phases

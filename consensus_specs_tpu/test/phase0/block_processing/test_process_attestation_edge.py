@@ -3,10 +3,11 @@ suite (spec: reference specs/phase0/beacon-chain.md:1804-1831, :719-735;
 altair/beacon-chain.md:454-490)."""
 from ...context import always_bls, spec_state_test, with_all_phases
 from ...helpers.attestations import (
-    get_valid_attestation, run_attestation_processing, sign_attestation,
+    get_valid_attestation,
+    run_attestation_processing,
 )
 from ...helpers.forks import is_post_altair
-from ...helpers.state import next_slot, next_slots, transition_to
+from ...helpers.state import next_slot, next_slots
 
 
 @with_all_phases

@@ -1,6 +1,6 @@
 """process_attester_slashing handler tests
 (reference: test/phase0/block_processing/test_process_attester_slashing.py)."""
-from ...context import always_bls, never_bls, spec_state_test, with_all_phases
+from ...context import always_bls, spec_state_test, with_all_phases
 from ...helpers.attestations import sign_indexed_attestation
 from ...helpers.attester_slashings import (
     get_indexed_attestation_participants, get_valid_attester_slashing,

@@ -3,7 +3,7 @@
 from ...context import (
     always_bls, expect_assertion_error, spec_state_test, with_all_phases,
 )
-from ...helpers.block import apply_randao_reveal, build_empty_block_for_next_slot
+from ...helpers.block import build_empty_block_for_next_slot
 from ...helpers.keys import privkeys
 from ...helpers.state import next_slot
 

@@ -1,7 +1,7 @@
 """process_voluntary_exit handler tests
 (reference: test/phase0/block_processing/test_process_voluntary_exit.py)."""
 from ...context import always_bls, spec_state_test, with_all_phases
-from ...helpers.keys import privkeys, pubkeys
+from ...helpers.keys import privkeys
 from ...helpers.voluntary_exits import (
     run_voluntary_exit_processing, sign_voluntary_exit,
 )
@@ -225,3 +225,45 @@ def test_exit_queue_spills_past_churn(spec, state):
     )
     yield from run_voluntary_exit_processing(spec, state, last)
     assert state.validators[indices[-1]].exit_epoch == base_epoch + 1
+
+
+from ...context import (  # noqa: E402
+    MINIMAL, default_activation_threshold, scaled_churn_balances, spec_test,
+    with_custom_state, with_presets,
+)
+
+
+@with_all_phases
+@with_presets([MINIMAL], reason="mainnet-scale scaled-churn registry exceeds the key pool")
+@spec_test
+@with_custom_state(scaled_churn_balances, default_activation_threshold)
+def test_success_exit_queue_scaled_churn(spec, state):
+    _fast_forward_to_exitable(spec, state)
+    churn = int(spec.get_validator_churn_limit(state))
+    assert churn > int(spec.config.MIN_PER_EPOCH_CHURN_LIMIT)
+
+    # fill one epoch's churn exactly, then one more: the spillover's exit
+    # epoch must be one later than the batch's
+    active = list(spec.get_active_validator_indices(state, spec.get_current_epoch(state)))
+    batch, extra = active[:churn], active[churn]
+    for i in batch:
+        exit_op = sign_voluntary_exit(
+            spec, state,
+            spec.VoluntaryExit(
+                epoch=spec.get_current_epoch(state), validator_index=i
+            ),
+            privkeys[i],
+        )
+        spec.process_voluntary_exit(state, exit_op)
+    batch_epochs = {int(state.validators[i].exit_epoch) for i in batch}
+    assert len(batch_epochs) == 1
+
+    exit_op = sign_voluntary_exit(
+        spec, state,
+        spec.VoluntaryExit(
+            epoch=spec.get_current_epoch(state), validator_index=extra
+        ),
+        privkeys[extra],
+    )
+    yield from run_voluntary_exit_processing(spec, state, exit_op)
+    assert int(state.validators[extra].exit_epoch) == next(iter(batch_epochs)) + 1

@@ -4,8 +4,14 @@
 Provenance: adapted from the reference's test/phase0/epoch_processing/test_process_registry_updates.py — scenario code and comments largely follow the reference test suite (round-1 port); newer suites in this repo are original.
 """
 from ...context import (
-    scaled_churn_balances, spec_state_test, spec_test, with_all_phases,
-    with_custom_state, zero_activation_threshold, default_activation_threshold,
+    MINIMAL,
+    scaled_churn_balances,
+    spec_state_test,
+    spec_test,
+    with_all_phases,
+    with_custom_state,
+    with_presets,
+    default_activation_threshold,
 )
 from ...helpers.epoch_processing import run_epoch_processing_with
 from ...helpers.state import next_epoch, next_slots
@@ -319,6 +325,7 @@ def test_activation_and_ejection_one_over_churn(spec, state):
 
 
 @with_all_phases
+@with_presets([MINIMAL], reason="mainnet-scale scaled-churn registry exceeds the key pool")
 @spec_test
 @with_custom_state(scaled_churn_balances, default_activation_threshold)
 def test_activation_and_ejection_at_scaled_churn_limit(spec, state):
@@ -329,6 +336,7 @@ def test_activation_and_ejection_at_scaled_churn_limit(spec, state):
 
 
 @with_all_phases
+@with_presets([MINIMAL], reason="mainnet-scale scaled-churn registry exceeds the key pool")
 @spec_test
 @with_custom_state(scaled_churn_balances, default_activation_threshold)
 def test_activation_and_ejection_over_scaled_churn_limit(spec, state):
@@ -336,6 +344,7 @@ def test_activation_and_ejection_over_scaled_churn_limit(spec, state):
 
 
 @with_all_phases
+@with_presets([MINIMAL], reason="mainnet-scale scaled-churn registry exceeds the key pool")
 @spec_test
 @with_custom_state(scaled_churn_balances, default_activation_threshold)
 def test_activation_queue_efficiency_scaled(spec, state):
@@ -358,6 +367,7 @@ def test_activation_queue_efficiency_scaled(spec, state):
 
 
 @with_all_phases
+@with_presets([MINIMAL], reason="mainnet-scale scaled-churn registry exceeds the key pool")
 @spec_test
 @with_custom_state(scaled_churn_balances, default_activation_threshold)
 def test_ejection_past_churn_limit_scaled(spec, state):

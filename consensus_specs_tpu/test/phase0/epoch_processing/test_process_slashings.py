@@ -148,3 +148,46 @@ def test_no_penalty_outside_withdrawable_window(spec, state):
     pre = int(state.balances[1])
     yield from run_epoch_processing_with(spec, state, 'process_slashings')
     assert int(state.balances[1]) == pre
+
+
+@with_all_phases
+@spec_state_test
+def test_low_penalty(spec, state):
+    # a single small slashing: the proportional penalty rounds down to the
+    # increment granularity (possibly zero) without underflow
+    from ...helpers.state import next_epoch
+
+    next_epoch(spec, state)
+    cur = spec.get_current_epoch(state)
+    window = spec.EPOCHS_PER_SLASHINGS_VECTOR // 2
+    slash_validators(spec, state, [4], [cur + window])
+    # shrink the recorded slashed balance to one increment
+    state.slashings[cur % spec.EPOCHS_PER_SLASHINGS_VECTOR] = (
+        spec.EFFECTIVE_BALANCE_INCREMENT
+    )
+    pre = int(state.balances[4])
+    yield from run_epoch_processing_with(spec, state, 'process_slashings')
+    assert int(state.balances[4]) <= pre
+
+
+@with_all_phases
+@spec_state_test
+def test_slashings_with_random_state(spec, state):
+    from random import Random
+
+    from ...helpers.state import next_epoch
+
+    rng = Random(7117)
+    next_epoch(spec, state)
+    cur = spec.get_current_epoch(state)
+    window = spec.EPOCHS_PER_SLASHINGS_VECTOR // 2
+    # random balances first, then a random stripe of slashed validators
+    # landing exactly in the penalty window
+    for i in range(len(state.validators)):
+        state.balances[i] = spec.Gwei(rng.randrange(1, int(spec.MAX_EFFECTIVE_BALANCE * 2)))
+    victims = sorted(rng.sample(range(len(state.validators)), 5))
+    slash_validators(spec, state, victims, [cur + window] * len(victims))
+    pre = [int(state.balances[v]) for v in victims]
+    yield from run_epoch_processing_with(spec, state, 'process_slashings')
+    for v, p in zip(victims, pre):
+        assert int(state.balances[v]) <= p

@@ -8,13 +8,14 @@ from ...context import (
 from ...helpers.attestations import get_valid_attestation
 from ...helpers.block import build_empty_block_for_next_slot
 from ...helpers.fork_choice import (
-    add_attestation, add_block, apply_next_epoch_with_attestations,
-    get_anchor_parts, get_genesis_forkchoice_store_and_block, slot_time,
-    tick_and_add_block, tick_to_slot,
+    add_attestation,
+    apply_next_epoch_with_attestations,
+    get_anchor_parts,
+    get_genesis_forkchoice_store_and_block,
+    tick_and_add_block,
+    tick_to_slot,
 )
-from ...helpers.state import (
-    next_epoch, next_slot, state_transition_and_sign_block,
-)
+from ...helpers.state import next_epoch, state_transition_and_sign_block
 
 
 @with_all_phases

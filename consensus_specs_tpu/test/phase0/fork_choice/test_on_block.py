@@ -7,11 +7,14 @@ from ...context import (
 )
 from ...helpers.block import build_empty_block, build_empty_block_for_next_slot, sign_block
 from ...helpers.fork_choice import (
-    add_block, apply_next_epoch_with_attestations,
-    get_genesis_forkchoice_store_and_block, run_on_block, slot_time,
-    tick_and_add_block, tick_to_slot,
+    add_block,
+    apply_next_epoch_with_attestations,
+    get_genesis_forkchoice_store_and_block,
+    run_on_block,
+    tick_and_add_block,
+    tick_to_slot,
 )
-from ...helpers.state import next_epoch, state_transition_and_sign_block
+from ...helpers.state import state_transition_and_sign_block
 
 
 @with_all_phases

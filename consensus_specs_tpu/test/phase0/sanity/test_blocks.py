@@ -14,10 +14,12 @@ from ...helpers.block import (
     transition_unsigned_block,
 )
 from ...helpers.deposits import prepare_state_and_deposit
-from ...helpers.keys import privkeys, pubkeys
+from ...helpers.keys import pubkeys
 from ...helpers.proposer_slashings import get_valid_proposer_slashing
 from ...helpers.state import (
-    next_epoch, next_slot, state_transition_and_sign_block, transition_to,
+    next_epoch,
+    next_slot,
+    state_transition_and_sign_block,
 )
 from ...helpers.voluntary_exits import prepare_signed_exits
 

@@ -286,3 +286,132 @@ def test_eth1_vote_ignores_noncandidate_chain(spec, state):
     ]
     vote = spec.get_eth1_vote(state, chain)
     assert vote == state.eth1_data
+
+
+# -- round-4 additions: eth1 vote edge shapes, aggregation pipeline, and
+#    signature-domain separation ------------------------------------------
+
+
+@with_all_phases
+@spec_state_test
+def test_get_eth1_vote_tie_prefers_earliest(spec, state):
+    # a tie between two vote candidates resolves by list order (max with a
+    # count key keeps the first maximal element)
+    cfg = spec.config
+    follow_window = int(cfg.SECONDS_PER_ETH1_BLOCK * cfg.ETH1_FOLLOW_DISTANCE)
+    state.genesis_time = 3 * follow_window  # make the candidate window reachable
+    period_start = spec.voting_period_start_time(state)
+    blocks = []
+    for i, ts_back in enumerate((follow_window * 2,
+                                 follow_window + follow_window // 2)):
+        blocks.append(spec.Eth1Block(
+            timestamp=period_start - ts_back,
+            deposit_root=bytes([10 + i]) * 32,
+            deposit_count=state.eth1_data.deposit_count,
+        ))
+    votes = []
+    for b in blocks:  # one vote each: a genuine tie between two candidates
+        assert spec.is_candidate_block(b, period_start)
+        votes.append(spec.Eth1Data(
+            block_hash=spec.hash_tree_root(b),
+            deposit_root=b.deposit_root,
+            deposit_count=b.deposit_count,
+        ))
+    state.eth1_data_votes = votes
+    vote = spec.get_eth1_vote(state, blocks)
+    assert vote == votes[0]  # first maximal element wins the tie
+
+
+@with_all_phases
+@spec_state_test
+def test_get_eth1_vote_chain_entirely_in_past(spec, state):
+    # every known eth1 block is older than the voting window: fall back to
+    # the default vote (state.eth1_data)
+    cfg = spec.config
+    follow_window = int(cfg.SECONDS_PER_ETH1_BLOCK * cfg.ETH1_FOLLOW_DISTANCE)
+    state.genesis_time = 10 * follow_window
+    period_start = spec.voting_period_start_time(state)
+    ancient = spec.Eth1Block(
+        timestamp=max(0, int(period_start) - follow_window * 8),
+        deposit_root=b"\x77" * 32,
+        deposit_count=state.eth1_data.deposit_count,
+    )
+    state.eth1_data_votes = []
+    vote = spec.get_eth1_vote(state, [ancient])
+    assert vote == state.eth1_data or vote.deposit_count == state.eth1_data.deposit_count
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_get_aggregate_and_proof_roundtrip(spec, state):
+    # aggregator builds AggregateAndProof; the selection proof must verify
+    # under DOMAIN_SELECTION_PROOF and the envelope under DOMAIN_AGGREGATE_AND_PROOF
+    attestation = get_valid_attestation(spec, state, signed=True)
+    slot = attestation.data.slot
+    committee = spec.get_beacon_committee(state, slot, attestation.data.index)
+    aggregator = committee[0]
+    privkey = privkeys[aggregator]
+    aap = spec.get_aggregate_and_proof(state, aggregator, attestation, privkey)
+    assert aap.aggregator_index == aggregator
+    assert aap.aggregate == attestation
+    # selection proof binds the slot
+    domain = spec.get_domain(state, spec.DOMAIN_SELECTION_PROOF, spec.compute_epoch_at_slot(slot))
+    signing_root = spec.compute_signing_root(spec.Slot(slot), domain)
+    assert spec.bls.Verify(pubkeys[aggregator], signing_root, aap.selection_proof)
+    # envelope signature
+    sig = spec.get_aggregate_and_proof_signature(state, aap, privkey)
+    domain2 = spec.get_domain(state, spec.DOMAIN_AGGREGATE_AND_PROOF, spec.compute_epoch_at_slot(slot))
+    signing_root2 = spec.compute_signing_root(aap, domain2)
+    assert spec.bls.Verify(pubkeys[aggregator], signing_root2, sig)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_signature_domains_are_disjoint(spec, state):
+    # the same message signed under different duty domains must never
+    # cross-verify — the domain-separation property every duty relies on
+    sk = privkeys[0]
+    pk = pubkeys[0]
+    epoch = spec.get_current_epoch(state)
+    msg = spec.Epoch(epoch)
+    domains = [
+        spec.get_domain(state, d, epoch)
+        for d in (spec.DOMAIN_RANDAO, spec.DOMAIN_SELECTION_PROOF, spec.DOMAIN_BEACON_ATTESTER)
+    ]
+    sigs = [spec.bls.Sign(sk, spec.compute_signing_root(msg, d)) for d in domains]
+    for i, d in enumerate(domains):
+        for j, s in enumerate(sigs):
+            ok = spec.bls.Verify(pk, spec.compute_signing_root(msg, d), s)
+            assert ok == (i == j)
+
+
+@with_all_phases
+@spec_state_test
+def test_compute_subnet_spreads_committees(spec, state):
+    # distinct (slot, committee) pairs land on distinct subnets within one
+    # slot's committee range
+    epoch = spec.get_current_epoch(state)
+    committees = int(spec.get_committee_count_per_slot(state, epoch))
+    slot = state.slot
+    subnets = {
+        int(spec.compute_subnet_for_attestation(committees, slot, idx))
+        for idx in range(committees)
+    }
+    assert len(subnets) == committees
+
+
+@with_all_phases
+@spec_state_test
+def test_is_aggregator_threshold_boundary(spec, state):
+    # a committee smaller than TARGET_AGGREGATORS_PER_COMMITTEE makes the
+    # modulo 1 -> everyone aggregates regardless of signature
+    slot = state.slot
+    committee = spec.get_beacon_committee(state, slot, 0)
+    if len(committee) <= spec.TARGET_AGGREGATORS_PER_COMMITTEE:
+        sig = spec.bls.Sign(privkeys[committee[0]], b"\x11" * 32)
+        assert spec.is_aggregator(state, slot, 0, sig)
+    else:
+        modulo = len(committee) // int(spec.TARGET_AGGREGATORS_PER_COMMITTEE)
+        assert modulo >= 1

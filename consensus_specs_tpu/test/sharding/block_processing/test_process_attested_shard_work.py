@@ -1,6 +1,6 @@
 """Shard-work vote accounting through the extended attestation processing
 (original; reference specs/sharding/beacon-chain.md:584-672)."""
-from ...context import CUSTODY_GAME, SHARDING, expect_assertion_error, spec_state_test, with_phases
+from ...context import CUSTODY_GAME, SHARDING, spec_state_test, with_phases
 from ...helpers.attestations import get_valid_attestation, sign_attestation
 from ...helpers.shard_blob import build_shard_blob_header
 from ...helpers.state import next_epoch, next_slot

@@ -19,9 +19,22 @@ from typing import Sequence
 
 from . import bls12_381 as oracle
 from .bls12_381 import (
-    G1_GEN, R, ec_add, ec_eq, ec_from_affine, ec_mul, ec_neg, ec_to_affine,
-    g1_from_bytes, g1_to_bytes, g2_from_bytes, g2_to_bytes, hash_to_g2,
-    is_in_g1_subgroup, is_in_g2_subgroup, multi_pairing, Fq12,
+    G1_GEN,
+    R,
+    ec_add,
+    ec_from_affine,
+    ec_mul,
+    ec_neg,
+    ec_to_affine,
+    g1_from_bytes,
+    g1_to_bytes,
+    g2_from_bytes,
+    g2_to_bytes,
+    hash_to_g2,
+    is_in_g1_subgroup,
+    is_in_g2_subgroup,
+    multi_pairing,
+    Fq12,
 )
 
 bls_active = True

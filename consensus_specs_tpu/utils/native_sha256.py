@@ -11,7 +11,6 @@ the native path is a throughput component, never a correctness dependency.
 """
 import ctypes
 import hashlib
-import os
 import subprocess
 from pathlib import Path
 

@@ -10,7 +10,7 @@ A generalized index addresses a node in the Merkle tree of an SSZ object:
 the root is 1 and the children of node ``i`` are ``2i`` and ``2i+1``
 (merkle-proofs.md:58-78).
 """
-from typing import Type, Union as PyUnion
+from typing import Type
 
 from .ssz_typing import (
     Bitlist, Bitvector, ByteList, ByteVector, Container, List, Vector, View,

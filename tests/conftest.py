@@ -5,7 +5,6 @@ multi-chip sharding tests (mesh/pjit/shard_map) run without TPU hardware.
 Also wires the reference-style CLI flags (--preset/--fork/--disable-bls)
 (reference: tests/core/pyspec/eth2spec/test/conftest.py:30-93).
 """
-import os
 
 # Override — don't setdefault. The outer environment may carry
 # JAX_PLATFORMS=axon (a single-TPU tunnel); under that, the first device op

@@ -8,11 +8,29 @@ import pytest
 
 from consensus_specs_tpu.utils import bls
 from consensus_specs_tpu.utils.bls12_381 import (
-    Fq, Fq2, Fq12, G1_GEN, G2_GEN, P, R, B_G2, ec_add, ec_eq, ec_from_affine,
-    ec_mul, ec_neg, ec_to_affine, g1_from_bytes, g1_to_bytes, g2_from_bytes,
-    g2_to_bytes, hash_to_g2, is_on_curve_g1, is_on_curve_g2,
-    is_in_g2_subgroup, iso_map_g2, map_to_curve_sswu_g2, pairing,
-    hash_to_field_fq2, expand_message_xmd,
+    Fq2,
+    Fq12,
+    G1_GEN,
+    G2_GEN,
+    R,
+    B_G2,
+    ec_add,
+    ec_eq,
+    ec_mul,
+    ec_neg,
+    ec_to_affine,
+    g1_from_bytes,
+    g1_to_bytes,
+    g2_from_bytes,
+    g2_to_bytes,
+    hash_to_g2,
+    is_on_curve_g1,
+    is_on_curve_g2,
+    is_in_g2_subgroup,
+    iso_map_g2,
+    map_to_curve_sswu_g2,
+    pairing,
+    expand_message_xmd,
 )
 
 pytestmark = pytest.mark.bls  # crypto-heavy suite

@@ -3,7 +3,6 @@ the SURVEY §7.3 #1 fallback representation for v5e's 32-bit vector units."""
 from random import Random
 
 import numpy as np
-import pytest
 
 from consensus_specs_tpu.ops import fq32
 from consensus_specs_tpu.utils.bls12_381 import P

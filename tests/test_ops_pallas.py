@@ -6,7 +6,6 @@ real hardware; the Mosaic-lowered A/B measurement is staged in
 tools/tpu_probe.py and gated on a granted tunnel window — TPU_NOTES.md).
 """
 import numpy as np
-import pytest
 
 from consensus_specs_tpu.utils.jax_env import force_cpu
 

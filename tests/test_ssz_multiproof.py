@@ -22,7 +22,6 @@ from consensus_specs_tpu.utils.ssz.ssz_typing import (
     List,
     Union,
     Vector,
-    uint8,
     uint64,
 )
 

@@ -4,7 +4,6 @@ pure-Python oracle. All programs here share one small shape bucket
 one XLA compile (persistent-cached on disk afterwards)."""
 import random
 
-import numpy as np
 import pytest
 
 jax = pytest.importorskip("jax")

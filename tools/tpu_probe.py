@@ -1,4 +1,4 @@
-import sys, time, faulthandler, threading, os
+import sys, time, faulthandler, os
 """Staged axon/TPU diagnostic: init -> u32 -> u64 -> mont_mul vs oracle.
 
 See TPU_NOTES.md. Each stage prints latency or the failure; a watchdog dumps
