@@ -266,3 +266,37 @@ def test_header_reflects_transactions_root(spec, state):
     assert state.latest_execution_payload_header.transactions_root == (
         spec.hash_tree_root(payload.transactions)
     )
+
+
+# -- round-4 additions -------------------------------------------------------
+
+
+@with_phases([MERGE])
+@spec_state_test
+def test_first_payload_with_gap_slot(spec, state):
+    # the merge-transition block may land after skipped slots: the payload
+    # timestamp must track the BLOCK's slot, not the parent's
+    from ...helpers.state import next_slots
+
+    next_slots(spec, state, 3)
+    payload = build_empty_execution_payload(spec, state)
+    yield from run_execution_payload_processing(spec, state, payload)
+
+
+@with_phases([MERGE])
+@spec_state_test
+def test_bad_timestamp_first_payload(spec, state):
+    payload = build_empty_execution_payload(spec, state)
+    payload.timestamp = payload.timestamp + 1
+    yield from run_execution_payload_processing(spec, state, payload, valid=False)
+
+
+@with_phases([MERGE])
+@spec_state_test
+def test_non_empty_extra_data_regular_payload(spec, state):
+    from ...helpers.execution_payload import build_state_with_complete_transition
+
+    build_state_with_complete_transition(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.extra_data = b"\x42" * 12
+    yield from run_execution_payload_processing(spec, state, payload)
