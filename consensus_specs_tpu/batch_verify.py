@@ -18,13 +18,17 @@ What is deferred vs eager — chosen by the spec's own failure semantics:
 - DEFERRED (assert-style; a failure invalidates the whole span anyway):
   aggregate attestation checks (``bls.FastAggregateVerify`` /
   ``bls.AggregateVerify``, incl. attester slashings and altair's
-  ``eth_fast_aggregate_verify``) and the block proposer signature
-  (``verify_block_signature``).
-- EAGER (oracle, unchanged): ``bls.Verify`` — because ``process_deposit``
-  uses it CONDITIONALLY (an invalid deposit PoP skips the validator instead
-  of failing the block, reference specs/phase0/beacon-chain.md:1871-1887);
-  deferring it optimistically would change the post-state. Randao/exit
-  verifies ride along eagerly; they are K=1 and rare.
+  ``eth_fast_aggregate_verify``), the block proposer signature
+  (``verify_block_signature``), and the assert-style ``bls.Verify`` calls
+  of ``process_randao``, ``process_voluntary_exit`` and
+  ``process_proposer_slashing`` (handler-scoped interception) — every
+  independent mainline-fork check rides the batched plane. The custody
+  draft's assert-style reveals stay eager (small, draft-only).
+- EAGER (oracle, unchanged): ``bls.Verify`` everywhere else — because
+  ``process_deposit`` uses it CONDITIONALLY (an invalid deposit PoP skips
+  the validator instead of failing the block, reference
+  specs/phase0/beacon-chain.md:1871-1887); deferring it optimistically
+  would change the post-state.
 
 ``flush()`` runs the recorded checks through the TPU backend's batched entry
 points, grouped by committee-size bucket so a lone 512-wide sync aggregate
@@ -62,8 +66,14 @@ class SignatureCollector:
         # call time inside the context would hit the interceptor and loop)
         self._orig_fast_aggregate_verify = bls.FastAggregateVerify
         self._orig_aggregate_verify = bls.AggregateVerify
+        self._orig_verify = bls.Verify
         self._saved_bls: Tuple = ()
         self._saved_vbs = None
+        self._saved_handlers: List = []
+        # True only while inside process_randao / process_voluntary_exit:
+        # their bls.Verify calls are assert-style and safe to defer, unlike
+        # process_deposit's conditional use
+        self._defer_verify = False
 
     # -- switchboard interception ------------------------------------------
 
@@ -121,20 +131,61 @@ class SignatureCollector:
         )
         return True
 
+    def _verify(self, pubkey, message, signature):
+        """bls.Verify interceptor: deferred only inside the assert-style
+        handlers (randao/exit); everywhere else — deposits included — the
+        real oracle answers eagerly."""
+        if not self._defer_verify:
+            return self._orig_verify(pubkey, message, signature)
+        if not bls.bls_active:
+            return True
+        self.checks.append(
+            CollectedCheck(
+                "fast_aggregate", [bytes(pubkey)], bytes(message), bytes(signature)
+            )
+        )
+        return True
+
+    def _deferring(self, handler):
+        """Wrap a spec handler so bls.Verify defers for its duration."""
+        def wrapped(*args, **kwargs):
+            was = self._defer_verify
+            self._defer_verify = True
+            try:
+                return handler(*args, **kwargs)
+            finally:
+                self._defer_verify = was
+
+        return wrapped
+
     def __enter__(self):
-        self._saved_bls = (bls.FastAggregateVerify, bls.AggregateVerify)
+        self._orig_verify = bls.Verify  # refresh: another collector may wrap
+        self._saved_bls = (
+            bls.FastAggregateVerify, bls.AggregateVerify, self._orig_verify,
+        )
         bls.FastAggregateVerify = self._fast_aggregate_verify
         bls.AggregateVerify = self._aggregate_verify
+        bls.Verify = self._verify
         if self.spec is not None and hasattr(self.spec, "verify_block_signature"):
             self._saved_vbs = self.spec.verify_block_signature
             self.spec.verify_block_signature = self._verify_block_signature
+        if self.spec is not None:
+            for name in ("process_randao", "process_voluntary_exit",
+                         "process_proposer_slashing"):
+                handler = getattr(self.spec, name, None)
+                if handler is not None:
+                    self._saved_handlers.append((name, handler))
+                    setattr(self.spec, name, self._deferring(handler))
         return self
 
     def __exit__(self, *exc):
-        bls.FastAggregateVerify, bls.AggregateVerify = self._saved_bls
+        bls.FastAggregateVerify, bls.AggregateVerify, bls.Verify = self._saved_bls
         if self._saved_vbs is not None:
             self.spec.verify_block_signature = self._saved_vbs
             self._saved_vbs = None
+        for name, handler in self._saved_handlers:
+            setattr(self.spec, name, handler)
+        self._saved_handlers = []
         return False
 
     # -- batched resolution -------------------------------------------------
