@@ -11,14 +11,15 @@ GENERATORS = operations sanity epoch_processing rewards finality forks transitio
         detect_generator_incomplete bench multichip clean_vectors \
         generate_random_tests
 
-# fast default: BLS stubbed except @always_bls (reference `make test`)
+# fast default: BLS stubbed except @always_bls, 4-way process-parallel
+# (reference `make test` = pytest -n 4, reference Makefile:100)
 test:
-	$(PYTEST) tests/ -q
+	$(PYTEST) tests/ -q -n 4
 
 # CI-grade: everything incl. slow VM/pairing compiles, real BLS via the
 # pure-python oracle (reference `make citest` runs milagro)
 citest:
-	$(PYTEST) tests/ -q --run-slow --enable-bls
+	$(PYTEST) tests/ -q -n 4 --run-slow --enable-bls
 
 # the flagship correctness gate: spec tests routed through the TPU backend
 test_tpu_backend:
