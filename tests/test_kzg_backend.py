@@ -1,0 +1,67 @@
+"""Device-batched KZG point-proof verification vs the host oracle
+(ops/kzg_backend.py; BASELINE config #5's device path)."""
+import pytest
+
+from consensus_specs_tpu.utils.jax_env import force_cpu
+
+force_cpu()
+
+from consensus_specs_tpu.utils import bls12_381 as O  # noqa: E402
+from consensus_specs_tpu.utils import kzg  # noqa: E402
+from consensus_specs_tpu.ops import kzg_backend  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def setup():
+    return kzg.lazy_setup(tau=0x5EED, n=16)
+
+
+def _cases(setup, count=3):
+    """(commitment, proof, z, y, expected) tuples: valid proofs, a wrong-y
+    proof, and a wrong-point proof."""
+    out = []
+    for i in range(count):
+        coeffs = [(7 * i + j * j + 1) % kzg.MODULUS for j in range(5 + i)]
+        commitment = kzg.commit_to_poly(setup, coeffs)
+        z = (31 * i + 2) % kzg.MODULUS
+        proof, y = kzg.prove_at_point(setup, coeffs, z)
+        out.append((commitment, proof, z, y, True))
+    # wrong claimed value
+    c, p, z, y, _ = out[0]
+    out.append((c, p, z, (y + 1) % kzg.MODULUS, False))
+    # proof for a different point
+    c2, p2, z2, y2, _ = out[1]
+    out.append((c2, p2, (z2 + 5) % kzg.MODULUS, y2, False))
+    return out
+
+
+@pytest.mark.slow
+def test_batch_matches_oracle(setup):
+    cases = _cases(setup)
+    got = kzg_backend.batch_verify_point_proofs(
+        setup,
+        [c for c, p, z, y, e in cases],
+        [p for c, p, z, y, e in cases],
+        [z for c, p, z, y, e in cases],
+        [y for c, p, z, y, e in cases],
+    )
+    want = [e for c, p, z, y, e in cases]
+    oracle = [
+        kzg.verify_point_proof(setup, c, p, z, y) for c, p, z, y, _ in cases
+    ]
+    assert oracle == want  # the oracle agrees with the constructed truth
+    assert list(got) == want, (list(got), want)
+
+
+@pytest.mark.slow
+def test_identity_commitment_edge(setup):
+    # p(X) = y0 constant: proof is the zero polynomial commitment
+    # (infinity); the device path must absorb the infinity lane and agree
+    coeffs = [11]
+    commitment = kzg.commit_to_poly(setup, coeffs)
+    proof, y = kzg.prove_at_point(setup, coeffs, z=4)
+    got = kzg_backend.batch_verify_point_proofs(
+        setup, [commitment], [proof], [4], [y]
+    )
+    assert bool(got[0]) == kzg.verify_point_proof(setup, commitment, proof, 4, y)
+    assert bool(got[0])
