@@ -58,12 +58,23 @@ def _enable_persistent_compile_cache() -> None:
 _enable_persistent_compile_cache()
 
 # VM shape buckets (compile cost is per bucket; the assembled-program build is
-# in-process lru_cached and the XLA executables persist via the compilation
-# cache configured above)
-W_MUL = 64
-W_LIN = 64
+# disk-cached under .vm_cache/ and in-process lru_cached; the XLA executables
+# persist via the compilation cache configured above).
+#
+# LANE FOLDING: a single verification item's instruction-level parallelism
+# fills only ~1/3 of the mul lanes (Miller) and ~7% (hard part) — the
+# schedules are depth-bound, and idle lanes burn the same SIMD work as live
+# ones. Folding F independent items into one program multiplies per-step ILP
+# by F: measured per-item mul-slot cost drops ~2x (Miller) and ~10x (hard
+# part), the single largest device-side win toward the BASELINE north star.
+W_MUL = 96
+W_LIN = 192
 PAD_STEPS = 256
-_K_BUCKETS = [1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048]
+# 160 covers the mainnet target committee (~146 = 300k/2048) without padding
+# to 256 — less aggregation waste and 1.6x less input transfer per item
+_K_BUCKETS = [1, 2, 4, 8, 16, 32, 64, 128, 160, 256, 512, 1024, 2048]
+
+_VM_CACHE_VERSION = 1
 
 
 def _k_bucket(k: int) -> int:
@@ -80,22 +91,98 @@ def _pow2(n: int) -> int:
     return b
 
 
+def _pow2_floor(n: int) -> int:
+    b = 1
+    while b * 2 <= n:
+        b <<= 1
+    return b
+
+
+def _fold_for(kind: str, k: int, n_items: int = 1 << 30) -> int:
+    """Items folded per program row — enough to saturate the lanes, capped
+    so the register file stays modest for wide-committee buckets, and
+    never exceeding the batch itself (a single verify must not pay for a
+    mostly-filler folded program)."""
+    if kind == "hard_part":
+        table = 16
+    elif k <= 64:
+        table = 8
+    elif k <= 256:
+        table = 4
+    elif k <= 512:
+        table = 2
+    else:
+        table = 1
+    return min(table, _pow2_floor(max(1, n_items)))
+
+
+def _vm_cache_dir() -> str:
+    d = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+        ".vm_cache",
+    )
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+@functools.lru_cache(maxsize=1)
+def _builder_fingerprint() -> str:
+    """Hash of the program-builder sources (vmlib + vm), baked into the
+    disk-cache key so editing a formula can never silently serve a stale
+    assembled instruction stream."""
+    import hashlib
+
+    h = hashlib.sha256()
+    for mod in (vmlib, vm, fq):  # fq drives bound tracking + limb layout
+        try:
+            with open(mod.__file__, "rb") as fh:
+                h.update(fh.read())
+        except OSError:
+            h.update(repr(mod).encode())
+    return h.hexdigest()[:10]
+
+
 @functools.lru_cache(maxsize=None)
-def _program(kind: str, k: int = 0) -> vm.Program:
+def _program(kind: str, k: int = 0, fold: int = None) -> Tuple[vm.Program, int]:
+    """Assembled program + its fold factor. Assembly of a folded program is
+    seconds-to-minutes of host Python (list scheduling over ~300k ops), so
+    the result is disk-cached — a granted TPU window must never pay it."""
+    import pickle
+
+    if fold is None:
+        fold = _fold_for(kind, k)
+    path = os.path.join(
+        _vm_cache_dir(),
+        f"v{_VM_CACHE_VERSION}_{_builder_fingerprint()}_{kind}_k{k}_f{fold}"
+        f"_w{W_MUL}x{W_LIN}_p{PAD_STEPS}.pkl",
+    )
+    try:
+        with open(path, "rb") as fh:
+            return pickle.load(fh), fold
+    except Exception:
+        pass  # absent/stale cache: assemble below
     if kind == "miller_product":
-        prog = vmlib.build_miller_product(k)
+        prog = vmlib.build_miller_product(k, fold)
     elif kind == "aggregate_verify":
-        prog = vmlib.build_aggregate_verify_miller(k)
+        prog = vmlib.build_aggregate_verify_miller(k, fold)
     elif kind == "hard_part":
-        prog = vmlib.build_hard_part()
+        prog = vmlib.build_hard_part(fold)
     else:
         raise ValueError(kind)
-    return prog.assemble(
+    assembled = prog.assemble(
         w_mul=W_MUL,
         w_lin=W_LIN,
         pad_steps_to=PAD_STEPS,
         pad_regs_to=_pow2(64),
     )
+    try:
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "wb") as fh:
+            pickle.dump(assembled, fh)
+        os.replace(tmp, path)
+    except Exception:
+        pass  # cache write is an optimization only
+    return assembled, fold
 
 
 # ---------------------------------------------------------------------------
@@ -109,14 +196,19 @@ _INF_G1 = (
 )  # projective infinity (0:1:0)
 _ONE_LIMBS = fq.to_mont_int(1)
 
-# G2 generator limbs (filler for inactive batch lanes)
+# G2 generator limbs, stacked (x.0, x.1, y.0, y.1) x L — filler for
+# inactive batch lanes
 _G2GEN = O.ec_to_affine(O.G2_GEN)
-_G2GEN_LIMBS = {
-    "x.0": fq.to_mont_int(_G2GEN[0].c0),
-    "x.1": fq.to_mont_int(_G2GEN[0].c1),
-    "y.0": fq.to_mont_int(_G2GEN[1].c0),
-    "y.1": fq.to_mont_int(_G2GEN[1].c1),
-}
+_G2GEN_LIMBS = np.stack(
+    [
+        fq.to_mont_int(_G2GEN[0].c0),
+        fq.to_mont_int(_G2GEN[0].c1),
+        fq.to_mont_int(_G2GEN[1].c0),
+        fq.to_mont_int(_G2GEN[1].c1),
+    ]
+)
+
+_G2_COMPS = ("x.0", "x.1", "y.0", "y.1")
 
 
 @functools.lru_cache(maxsize=1 << 20)
@@ -132,30 +224,36 @@ def _pubkey_limbs(pk: bytes) -> Tuple[np.ndarray, np.ndarray]:
 
 
 @functools.lru_cache(maxsize=1 << 16)
-def _signature_limbs(sig: bytes) -> Dict[str, np.ndarray]:
+def _signature_limbs(sig: bytes) -> np.ndarray:
+    """(4, L) stacked (x.0, x.1, y.0, y.1) Montgomery limbs."""
     aff = O.g2_from_bytes(sig)
     if aff is None:
         raise ValueError("signature is the point at infinity")
     if not O.is_in_g2_subgroup(O.ec_from_affine(aff)):
         raise ValueError("signature not in G2 subgroup")
     x, y = aff
-    return {
-        "x.0": fq.to_mont_int(x.c0),
-        "x.1": fq.to_mont_int(x.c1),
-        "y.0": fq.to_mont_int(y.c0),
-        "y.1": fq.to_mont_int(y.c1),
-    }
+    return np.stack(
+        [
+            fq.to_mont_int(x.c0),
+            fq.to_mont_int(x.c1),
+            fq.to_mont_int(y.c0),
+            fq.to_mont_int(y.c1),
+        ]
+    )
 
 
 @functools.lru_cache(maxsize=1 << 16)
-def _message_limbs(message: bytes) -> Dict[str, np.ndarray]:
+def _message_limbs(message: bytes) -> np.ndarray:
+    """(4, L) stacked hash-to-G2 point limbs."""
     x, y = O.ec_to_affine(O.hash_to_g2(message, DST))
-    return {
-        "x.0": fq.to_mont_int(x.c0),
-        "x.1": fq.to_mont_int(x.c1),
-        "y.0": fq.to_mont_int(y.c0),
-        "y.1": fq.to_mont_int(y.c1),
-    }
+    return np.stack(
+        [
+            fq.to_mont_int(x.c0),
+            fq.to_mont_int(x.c1),
+            fq.to_mont_int(y.c0),
+            fq.to_mont_int(y.c1),
+        ]
+    )
 
 
 def _flat_ints_to_oracle(coeffs: Sequence[int]) -> O.Fq12:
@@ -191,16 +289,71 @@ def _easy_part_flat(f_coeffs: List[int]) -> Optional[List[int]]:
     return _oracle_to_flat_ints(g)
 
 
+def _ns(fold: int, t: int) -> str:
+    return f"i{t}." if fold > 1 else ""
+
+
+def _rows_for(n_items: int, fold: int, mesh) -> int:
+    rows = _pow2(max(1, -(-n_items // fold)))
+    if mesh is not None:
+        rows = max(rows, int(np.prod(list(mesh.shape.values()))))
+    return rows
+
+
+class _FoldLayout:
+    """Row/lane layout of a folded batch — the ONE place that knows item i
+    lives at row i // fold under name prefix _ns(fold, i % fold). Used by
+    every folded entry point (both BLS batch verifies, the hard part, and
+    the KZG backend) so the scatter and the readback can never diverge."""
+
+    __slots__ = ("program", "fold", "rows", "nb")
+
+    def __init__(self, kind: str, k: int, n_items: int, mesh):
+        fold = _fold_for(kind, k, n_items)
+        if mesh is not None:
+            # the mesh pads rows up to the device count anyway, so folding
+            # past ceil(n/devices) just runs a bigger program on filler
+            n_dev = int(np.prod(list(mesh.shape.values())))
+            fold = min(fold, _pow2(max(1, -(-n_items // n_dev))))
+        self.program, self.fold = _program(kind, k, fold=fold)
+        self.rows = _rows_for(n_items, self.fold, mesh)
+        self.nb = self.rows * self.fold
+
+    def views(self, arr: np.ndarray) -> np.ndarray:
+        """(nb, ...) staging array -> (rows, fold, ...) view."""
+        return arr.reshape((self.rows, self.fold) + arr.shape[1:])
+
+    def split(self, i: int) -> Tuple[int, str]:
+        """Item index -> (row, name prefix)."""
+        r, t = divmod(i, self.fold)
+        return r, _ns(self.fold, t)
+
+    def scatter(self, ins: Dict[str, np.ndarray], arr: np.ndarray, name_fn):
+        """Register a (nb, *inner, L) staging array's slices under their
+        folded input names: ins[prefix + name_fn(*inner_idx)]."""
+        v = self.views(arr)
+        inner = v.shape[2:-1]
+        for t in range(self.fold):
+            ns = _ns(self.fold, t)
+            for idx in np.ndindex(*inner):
+                ins[ns + name_fn(*idx)] = v[(slice(None), t) + idx]
+
+
 def _run_hard_part(g_flat_batch: np.ndarray, mesh=None) -> np.ndarray:
     """(N, 12, L) unitary g limb batch -> (N,) bool (res == 1)."""
     n = g_flat_batch.shape[0]
-    prB = _program("hard_part")
-    ins = {f"g.{i}": g_flat_batch[:, i] for i in range(12)}
-    out = vm.execute(prB, ins, batch_shape=(n,), mesh=mesh)
+    lay = _FoldLayout("hard_part", 0, n, mesh)
+    L = fq.NUM_LIMBS
+    gb = np.zeros((lay.nb, 12, L), dtype=np.uint64)
+    gb[:n] = g_flat_batch
+    ins = {}
+    lay.scatter(ins, gb, lambda i: f"g.{i}")
+    out = vm.execute(lay.program, ins, batch_shape=(lay.rows,), mesh=mesh)
     ok = np.zeros(n, dtype=bool)
     for i in range(n):
-        res = [fq.from_mont_limbs(out[f"res.{j}"][i]) for j in range(12)]
-        ok[i] = res[0] == 1 and all(r == 0 for r in res[1:])
+        r, ns = lay.split(i)
+        res = [fq.from_mont_limbs(out[f"{ns}res.{j}"][r]) for j in range(12)]
+        ok[i] = res[0] == 1 and all(rc == 0 for rc in res[1:])
     return ok
 
 
@@ -225,20 +378,23 @@ def batch_fast_aggregate_verify(
         return np.zeros(0, dtype=bool)
     max_k = max((len(pks) for pks in pubkey_sets), default=1)
     k = _k_bucket(max(1, max_k))
-    nb = _pow2(n)
-    if mesh is not None:
-        nb = max(nb, int(np.prod(list(mesh.shape.values()))))
     L = fq.NUM_LIMBS
 
-    prA = _program("miller_product", k)
+    lay = _FoldLayout("miller_product", k, n, mesh)
+    prA, fold, rows, nb = lay.program, lay.fold, lay.rows, lay.nb
+
+    # stacked staging arrays (vectorized — the per-name dict assignment loop
+    # was ~1.5 s of host time at epoch scale); inactive-lane fillers:
+    # infinity pubkeys (0:1:0), generator G2 points
     precheck = np.zeros(nb, dtype=bool)
-    ins = {name: np.zeros((nb, L), dtype=np.uint64) for name in prA.input_names}
-    # inactive-lane fillers: infinity pubkeys, generator G2 points
-    for j in range(k):
-        ins[f"pk{j}.y"][:] = _INF_G1[1]
-    for nm in ("h", "sig"):
-        for c, v in _G2GEN_LIMBS.items():
-            ins[f"{nm}.{c}"][:] = v
+    pk_x = np.zeros((nb, k, L), dtype=np.uint64)
+    pk_y = np.zeros((nb, k, L), dtype=np.uint64)
+    pk_y[:] = _INF_G1[1]
+    pk_z = np.zeros((nb, k, L), dtype=np.uint64)
+    hm = np.zeros((nb, 4, L), dtype=np.uint64)
+    hm[:] = _G2GEN_LIMBS
+    sg = np.zeros((nb, 4, L), dtype=np.uint64)
+    sg[:] = _G2GEN_LIMBS
 
     for i, (pks, msg, sig) in enumerate(zip(pubkey_sets, messages, signatures)):
         try:
@@ -249,28 +405,35 @@ def batch_fast_aggregate_verify(
             h = _message_limbs(bytes(msg))
         except Exception:
             continue
-        for j, (x, y) in enumerate(enc):
-            ins[f"pk{j}.x"][i] = x
-            ins[f"pk{j}.y"][i] = y
-            ins[f"pk{j}.z"][i] = _ONE_LIMBS
-        for c in ("x.0", "x.1", "y.0", "y.1"):
-            ins[f"sig.{c}"][i] = s[c]
-            ins[f"h.{c}"][i] = h[c]
+        m = len(enc)
+        pk_x[i, :m] = [e[0] for e in enc]
+        pk_y[i, :m] = [e[1] for e in enc]
+        pk_z[i, :m] = _ONE_LIMBS
+        hm[i] = h
+        sg[i] = s
         precheck[i] = True
 
     if not precheck.any():
         return precheck[:n]
 
-    out = vm.execute(prA, ins, batch_shape=(nb,), mesh=mesh)
+    ins = {}
+    lay.scatter(ins, pk_x, lambda j: f"pk{j}.x")
+    lay.scatter(ins, pk_y, lambda j: f"pk{j}.y")
+    lay.scatter(ins, pk_z, lambda j: f"pk{j}.z")
+    lay.scatter(ins, hm, lambda ci: f"h.{_G2_COMPS[ci]}")
+    lay.scatter(ins, sg, lambda ci: f"sig.{_G2_COMPS[ci]}")
+
+    out = vm.execute(prA, ins, batch_shape=(rows,), mesh=mesh)
 
     agg_nonzero = np.zeros(nb, dtype=bool)
     g_batch = np.zeros((nb, 12, L), dtype=np.uint64)
     for i in range(nb):
         if not precheck[i]:
             continue
-        aggz = fq.from_mont_limbs(out["aggz"][i])
+        r, ns = lay.split(i)
+        aggz = fq.from_mont_limbs(out[f"{ns}aggz"][r])
         agg_nonzero[i] = aggz != 0
-        f_coeffs = [fq.from_mont_limbs(out[f"f.{j}"][i]) for j in range(12)]
+        f_coeffs = [fq.from_mont_limbs(out[f"{ns}f.{j}"][r]) for j in range(12)]
         g = _easy_part_flat(f_coeffs)
         if g is None:
             precheck[i] = False
@@ -299,20 +462,20 @@ def batch_aggregate_verify(
         (len(pks) for pks in pubkey_lists), default=1
     )
     k = _k_bucket(max(1, max_k))
-    nb = _pow2(n)
-    if mesh is not None:
-        nb = max(nb, int(np.prod(list(mesh.shape.values()))))
     L = fq.NUM_LIMBS
 
-    prA = _program("aggregate_verify", k)
+    lay = _FoldLayout("aggregate_verify", k, n, mesh)
+    prA, fold, rows, nb = lay.program, lay.fold, lay.rows, lay.nb
+
     precheck = np.zeros(nb, dtype=bool)
-    ins = {name: np.zeros((nb, L), dtype=np.uint64) for name in prA.input_names}
-    for j in range(k):
-        ins[f"pk{j}.y"][:] = _INF_G1[1]
-        for c, v in _G2GEN_LIMBS.items():
-            ins[f"h{j}.{c}"][:] = v
-    for c, v in _G2GEN_LIMBS.items():
-        ins[f"sig.{c}"][:] = v
+    pk_x = np.zeros((nb, k, L), dtype=np.uint64)
+    pk_y = np.zeros((nb, k, L), dtype=np.uint64)
+    pk_y[:] = _INF_G1[1]
+    pk_z = np.zeros((nb, k, L), dtype=np.uint64)
+    hm = np.zeros((nb, k, 4, L), dtype=np.uint64)
+    hm[:] = _G2GEN_LIMBS
+    sg = np.zeros((nb, 4, L), dtype=np.uint64)
+    sg[:] = _G2GEN_LIMBS
 
     for i, (pks, msgs, sig) in enumerate(
         zip(pubkey_lists, message_lists, signatures)
@@ -325,25 +488,31 @@ def batch_aggregate_verify(
             s = _signature_limbs(bytes(sig))
         except Exception:
             continue
-        for j, ((x, y), h) in enumerate(zip(enc, hs)):
-            ins[f"pk{j}.x"][i] = x
-            ins[f"pk{j}.y"][i] = y
-            ins[f"pk{j}.z"][i] = _ONE_LIMBS
-            for c in ("x.0", "x.1", "y.0", "y.1"):
-                ins[f"h{j}.{c}"][i] = h[c]
-        for c in ("x.0", "x.1", "y.0", "y.1"):
-            ins[f"sig.{c}"][i] = s[c]
+        m = len(enc)
+        pk_x[i, :m] = [e[0] for e in enc]
+        pk_y[i, :m] = [e[1] for e in enc]
+        pk_z[i, :m] = _ONE_LIMBS
+        hm[i, :m] = hs
+        sg[i] = s
         precheck[i] = True
 
     if not precheck.any():
         return precheck[:n]
 
-    out = vm.execute(prA, ins, batch_shape=(nb,), mesh=mesh)
+    ins = {}
+    lay.scatter(ins, pk_x, lambda j: f"pk{j}.x")
+    lay.scatter(ins, pk_y, lambda j: f"pk{j}.y")
+    lay.scatter(ins, pk_z, lambda j: f"pk{j}.z")
+    lay.scatter(ins, hm, lambda j, ci: f"h{j}.{_G2_COMPS[ci]}")
+    lay.scatter(ins, sg, lambda ci: f"sig.{_G2_COMPS[ci]}")
+
+    out = vm.execute(prA, ins, batch_shape=(rows,), mesh=mesh)
     g_batch = np.zeros((nb, 12, L), dtype=np.uint64)
     for i in range(nb):
         if not precheck[i]:
             continue
-        f_coeffs = [fq.from_mont_limbs(out[f"f.{j}"][i]) for j in range(12)]
+        r, ns = lay.split(i)
+        f_coeffs = [fq.from_mont_limbs(out[f"{ns}f.{j}"][r]) for j in range(12)]
         g = _easy_part_flat(f_coeffs)
         if g is None:
             precheck[i] = False

@@ -12,7 +12,7 @@ Equation mapping. The oracle checks
     e(C - [y]G1, G2) == e(pi, [tau - z]G2)            (verify_point_proof)
 
 The VM's AggregateVerify program computes prod_j e(pk_j, h_j) * e(-g1, sig)
-(ops/vmlib.py:484-505). Choosing
+(ops/vmlib.py). Choosing
 
     pk0 = pi,              h0  = [tau - z]G2
     pk1 = [y]G1 - C + G1,  h1  = G2 generator
@@ -35,18 +35,18 @@ from ..utils import bls12_381 as O
 from . import fq, vm
 from .bls_backend import (
     _G2GEN_LIMBS,
+    _G2_COMPS,
     _INF_G1,
     _ONE_LIMBS,
     _easy_part_flat,
-    _pow2,
-    _program,
+    _FoldLayout,
     _run_hard_part,
 )
 
 
 def _g1_limbs(pt):
-    """Oracle G1 point (jacobian/None) -> projective Montgomery limb dict
-    values; infinity -> (0:1:0)."""
+    """Oracle G1 point (jacobian/None) -> projective Montgomery limbs;
+    infinity -> (0:1:0)."""
     aff = O.ec_to_affine(pt)
     if aff is None:
         return _INF_G1[0], _INF_G1[1], _INF_G1[2]
@@ -58,18 +58,20 @@ def _g1_limbs(pt):
 
 
 def _g2_limbs(pt):
-    """Oracle G2 point -> affine Fq2 limb dict; None for infinity (caller
-    must fall back to the oracle for that item)."""
+    """Oracle G2 point -> stacked (4, L) affine Fq2 limbs; None for infinity
+    (caller must fall back to the oracle for that item)."""
     aff = O.ec_to_affine(pt)
     if aff is None:
         return None
     x, y = aff
-    return {
-        "x.0": fq.to_mont_int(x.c0),
-        "x.1": fq.to_mont_int(x.c1),
-        "y.0": fq.to_mont_int(y.c0),
-        "y.1": fq.to_mont_int(y.c1),
-    }
+    return np.stack(
+        [
+            fq.to_mont_int(x.c0),
+            fq.to_mont_int(x.c1),
+            fq.to_mont_int(y.c0),
+            fq.to_mont_int(y.c1),
+        ]
+    )
 
 
 def batch_verify_point_proofs(setup, commitments: Sequence, proofs: Sequence,
@@ -83,21 +85,20 @@ def batch_verify_point_proofs(setup, commitments: Sequence, proofs: Sequence,
     if n == 0:
         return np.zeros(0, dtype=bool)
 
-    prA = _program("aggregate_verify", 2)
-    nb = _pow2(n)
-    if mesh is not None:
-        nb = max(nb, int(np.prod(list(mesh.shape.values()))))
+    lay = _FoldLayout("aggregate_verify", 2, n, mesh)
+    prA, fold, rows, nb = lay.program, lay.fold, lay.rows, lay.nb
     L = fq.NUM_LIMBS
 
     active = np.zeros(nb, dtype=bool)
     oracle_fallback = {}  # index -> bool (degenerate [tau-z]G2)
-    ins = {name: np.zeros((nb, L), dtype=np.uint64) for name in prA.input_names}
-    for j in range(2):
-        ins[f"pk{j}.y"][:] = _INF_G1[1]
-        for c, v in _G2GEN_LIMBS.items():
-            ins[f"h{j}.{c}"][:] = v
-    for c, v in _G2GEN_LIMBS.items():
-        ins[f"sig.{c}"][:] = v
+    pk_x = np.zeros((nb, 2, L), dtype=np.uint64)
+    pk_y = np.zeros((nb, 2, L), dtype=np.uint64)
+    pk_y[:] = _INF_G1[1]
+    pk_z = np.zeros((nb, 2, L), dtype=np.uint64)
+    hm = np.zeros((nb, 2, 4, L), dtype=np.uint64)
+    hm[:] = _G2GEN_LIMBS
+    sg = np.zeros((nb, 4, L), dtype=np.uint64)
+    sg[:] = _G2GEN_LIMBS
 
     r = O.R
     for i in range(n):
@@ -117,23 +118,29 @@ def batch_verify_point_proofs(setup, commitments: Sequence, proofs: Sequence,
         c_term = O.ec_add(
             O.ec_add(O.ec_mul(O.G1_GEN, y), O.ec_neg(commitments[i])), O.G1_GEN
         )
-        x0, y0, z0 = _g1_limbs(proofs[i])
-        x1, y1, z1 = _g1_limbs(c_term)
-        ins["pk0.x"][i], ins["pk0.y"][i], ins["pk0.z"][i] = x0, y0, z0
-        ins["pk1.x"][i], ins["pk1.y"][i], ins["pk1.z"][i] = x1, y1, z1
-        for c, v in h0.items():
-            ins[f"h0.{c}"][i] = v
+        pk_x[i, 0], pk_y[i, 0], pk_z[i, 0] = _g1_limbs(proofs[i])
+        pk_x[i, 1], pk_y[i, 1], pk_z[i, 1] = _g1_limbs(c_term)
+        hm[i, 0] = h0
         active[i] = True
 
     out_ok = np.zeros(nb, dtype=bool)
     if active.any():
-        out = vm.execute(prA, ins, batch_shape=(nb,), mesh=mesh)
+        ins = {}
+        lay.scatter(ins, pk_x, lambda j: f"pk{j}.x")
+        lay.scatter(ins, pk_y, lambda j: f"pk{j}.y")
+        lay.scatter(ins, pk_z, lambda j: f"pk{j}.z")
+        lay.scatter(ins, hm, lambda j, ci: f"h{j}.{_G2_COMPS[ci]}")
+        lay.scatter(ins, sg, lambda ci: f"sig.{_G2_COMPS[ci]}")
+        out = vm.execute(prA, ins, batch_shape=(rows,), mesh=mesh)
         g_batch = np.zeros((nb, 12, L), dtype=np.uint64)
         usable = active.copy()
         for i in range(nb):
             if not usable[i]:
                 continue
-            f_coeffs = [fq.from_mont_limbs(out[f"f.{j}"][i]) for j in range(12)]
+            rr, ns = lay.split(i)
+            f_coeffs = [
+                fq.from_mont_limbs(out[f"{ns}f.{j}"][rr]) for j in range(12)
+            ]
             g = _easy_part_flat(f_coeffs)
             if g is None:
                 usable[i] = False

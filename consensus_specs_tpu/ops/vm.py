@@ -23,6 +23,7 @@ Register values are loose Montgomery residues (ops.fq conventions). The
 assembler tracks magnitude bounds per value and auto-inserts compress
 multiplies, so lazy reduction is handled statically at assembly time.
 """
+import os
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
@@ -332,6 +333,32 @@ class Program:
             regs[..., int(reg), :] = values[name]
         return regs
 
+    def const_template(self) -> np.ndarray:
+        """(n_regs, L) uint64 register template with constants loaded —
+        broadcast over the batch on DEVICE so the host never materializes
+        (or transfers) the full register file."""
+        t = np.zeros((self.n_regs, fq.NUM_LIMBS), dtype=np.uint64)
+        for reg, value in self.const_regs.items():
+            t[reg] = fq.to_mont_int(value)
+        return t
+
+    def stack_inputs(self, values: Dict[str, np.ndarray], batch_shape) -> np.ndarray:
+        """Stack named inputs into (batch..., n_inputs, L) uint32 in
+        input_names order. Program inputs are canonical Montgomery residues
+        (limbs < 2^28), so the u32 transfer encoding is exact — and half
+        the bytes over the (slow, tunneled) host->device link."""
+        n_in = len(self.input_names)
+        out = np.zeros(tuple(batch_shape) + (n_in, fq.NUM_LIMBS), dtype=np.uint32)
+        for idx, name in enumerate(self.input_names):
+            v = np.asarray(values[name], dtype=np.uint64)
+            if v.size and int(v.max()) >> 32:
+                raise ValueError(
+                    f"input {name!r} has limbs >= 2^32 — program inputs must "
+                    "be canonical Montgomery residues (limbs < 2^28)"
+                )
+            out[..., idx, :] = v
+        return out
+
 
 # MP + 1 in limb form: the additive shift of the borrowless subtract
 _MP_PLUS_1 = fq._int_to_limbs_np(fq.MP + 1)
@@ -354,10 +381,30 @@ def _vm_step(regs, instr):
     return regs, None
 
 
-@jax.jit
-def _vm_run(regs, instr_arrays):
-    regs, _ = jax.lax.scan(_vm_step, regs, instr_arrays)
-    return regs
+# lax.scan unroll factor: >1 fuses that many ALU steps per loop iteration,
+# trading compile time for less per-step loop/dispatch overhead on TPU.
+# Step counts are padded to multiples of 256 (bls_backend.PAD_STEPS), so
+# any power-of-two <= 256 divides evenly. Env-tunable for on-hardware A/B
+# (tools/tpu_probe.py); default 1 keeps compiles cheap.
+_SCAN_UNROLL = int(os.environ.get("CONSENSUS_SPECS_TPU_SCAN_UNROLL", "1"))
+
+
+def _vm_body(inputs_u32, template, input_regs, output_regs, instr):
+    """Device program: broadcast the (n_regs, L) const template over the
+    batch, scatter the compact u32 inputs in, scan the ALU steps, and slice
+    ONLY the output registers — so host<->device traffic is the compact
+    input stack in and the named outputs out, never the full register file
+    (which is tens of times larger at epoch scale)."""
+    batch = inputs_u32.shape[:-2]
+    regs = jnp.broadcast_to(
+        template, batch + template.shape
+    ).astype(jnp.uint64)
+    regs = regs.at[..., input_regs, :].set(inputs_u32.astype(jnp.uint64))
+    regs, _ = jax.lax.scan(_vm_step, regs, instr, unroll=_SCAN_UNROLL)
+    return regs[..., output_regs, :]
+
+
+_vm_run = jax.jit(_vm_body)
 
 
 import functools as _functools
@@ -375,48 +422,64 @@ def _vm_run_for_mesh(mesh):
     batch_sh = NamedSharding(mesh, P(axis))
     repl = NamedSharding(mesh, P())
     return jax.jit(
-        lambda regs, instr: jax.lax.scan(_vm_step, regs, instr)[0],
-        in_shardings=(batch_sh, tuple(repl for _ in range(7))),
+        _vm_body,
+        in_shardings=(
+            batch_sh,
+            repl,
+            repl,
+            repl,
+            tuple(repl for _ in range(7)),
+        ),
         out_shardings=batch_sh,
     )
 
 
 def execute(program: Program, inputs: Dict[str, np.ndarray], batch_shape=(),
             mesh=None) -> Dict[str, np.ndarray]:
-    """Run an assembled program. Input arrays must be Montgomery limb arrays
-    of shape batch_shape + (NUM_LIMBS,). Returns named outputs (loose,
-    bounded < 2^382). With ``mesh``, the leading batch axis is sharded over
-    the mesh's first axis (batch_shape[0] must divide by its size)."""
+    """Run an assembled program. Input arrays must be canonical Montgomery
+    limb arrays of shape batch_shape + (NUM_LIMBS,). Returns named outputs
+    (loose, bounded < 2^382). With ``mesh``, the leading batch axis is
+    sharded over the mesh's first axis (batch_shape[0] must divide by its
+    size)."""
     from . import profiling
 
-    regs = program.init_regs(tuple(batch_shape))
-    regs = program.load_inputs(regs, inputs)
+    stacked = program.stack_inputs(inputs, tuple(batch_shape))
+    template = program.const_template()
     instr = tuple(jnp.asarray(x) for x in program.instr)
     label = (
         f"vm[steps={program.n_steps},regs={program.n_regs},"
         f"batch={tuple(batch_shape)},sharded={mesh is not None}]"
     )
     with profiling.timed(label):
-        out = _execute_device(regs, instr, mesh)
+        out = _execute_device(
+            stacked, template, program.input_regs, program.output_regs,
+            instr, mesh,
+        )
     out = np.asarray(out)
     return {
-        name: out[..., int(reg), :]
-        for name, reg in zip(program.output_names, program.output_regs)
+        name: out[..., i, :]
+        for i, name in enumerate(program.output_names)
     }
 
 
-def _execute_device(regs, instr, mesh):
+def _execute_device(stacked, template, input_regs, output_regs, instr, mesh):
     if mesh is None:
-        out = _vm_run(jnp.asarray(regs), instr)
-    else:
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        return _vm_run(
+            jnp.asarray(stacked),
+            jnp.asarray(template),
+            jnp.asarray(input_regs),
+            jnp.asarray(output_regs),
+            instr,
+        )
+    from jax.sharding import NamedSharding, PartitionSpec as P
 
-        axis = mesh.axis_names[0]
-        regs_d = jax.device_put(
-            jnp.asarray(regs), NamedSharding(mesh, P(axis))
-        )
-        instr_d = tuple(
-            jax.device_put(x, NamedSharding(mesh, P())) for x in instr
-        )
-        out = _vm_run_for_mesh(mesh)(regs_d, instr_d)
-    return out
+    axis = mesh.axis_names[0]
+    batch_sh = NamedSharding(mesh, P(axis))
+    repl = NamedSharding(mesh, P())
+    stacked_d = jax.device_put(jnp.asarray(stacked), batch_sh)
+    args_d = tuple(
+        jax.device_put(jnp.asarray(x), repl)
+        for x in (template, input_regs, output_regs)
+    )
+    instr_d = tuple(jax.device_put(x, repl) for x in instr)
+    return _vm_run_for_mesh(mesh)(stacked_d, *args_d, instr_d)

@@ -453,21 +453,21 @@ _G1_X = 0x17F1D3A73197D7942695638C4FA9AC0FC3688C4F9774B905A14E3A3F171BAC586C55E8
 _G1_Y = 0x08B3F481E3AAA0F1A09E30ED741D8AE4FCF5E095D5D00AF600DB18CB2C04B3EDD03CC744A2888AE40CAA232946C5E7E1
 
 
-def build_miller_product(k_pubkeys: int) -> Prog:
-    """PROG A: aggregate K projective pubkeys + both Miller loops.
-
-    Inputs: pk{j}.{x,y,z} (projective G1; infinity = (0,1,0) for masked
-    lanes), h.{x,y}.{0,1} (H(m) on the twist, affine Fq2), sig.{x,y}.{0,1}.
-    Outputs: f.0..f.11 (Fq12, pre-final-exp), aggz (aggregate Z)."""
-    prog = Prog()
+def _emit_miller_product(prog: Prog, ns: str, k_pubkeys: int) -> None:
+    """One verification circuit (aggregate + both Miller loops) under name
+    prefix ``ns``; see build_miller_product."""
     pts = [
-        (prog.inp(f"pk{j}.x"), prog.inp(f"pk{j}.y"), prog.inp(f"pk{j}.z"))
+        (
+            prog.inp(f"{ns}pk{j}.x"),
+            prog.inp(f"{ns}pk{j}.y"),
+            prog.inp(f"{ns}pk{j}.z"),
+        )
         for j in range(k_pubkeys)
     ]
-    hx = f2_inputs(prog, "h.x")
-    hy = f2_inputs(prog, "h.y")
-    sx = f2_inputs(prog, "sig.x")
-    sy = f2_inputs(prog, "sig.y")
+    hx = f2_inputs(prog, f"{ns}h.x")
+    hy = f2_inputs(prog, f"{ns}h.y")
+    sx = f2_inputs(prog, f"{ns}sig.x")
+    sy = f2_inputs(prog, f"{ns}sig.y")
 
     agg = g1_tree_sum(prog, pts) if k_pubkeys > 1 else pts[0]
 
@@ -476,40 +476,69 @@ def build_miller_product(k_pubkeys: int) -> Prog:
     f2_ = miller_loop(prog, (sx, sy), ng)
     f = f12_mul(prog, f1, f2_)
     for i in range(12):
-        prog.out(f[i], f"f.{i}")
-    prog.out(agg[2], "aggz")
+        prog.out(f[i], f"{ns}f.{i}")
+    prog.out(agg[2], f"{ns}aggz")
+
+
+def build_miller_product(k_pubkeys: int, fold: int = 1) -> Prog:
+    """PROG A: aggregate K projective pubkeys + both Miller loops.
+
+    Inputs: pk{j}.{x,y,z} (projective G1; infinity = (0,1,0) for masked
+    lanes), h.{x,y}.{0,1} (H(m) on the twist, affine Fq2), sig.{x,y}.{0,1}.
+    Outputs: f.0..f.11 (Fq12, pre-final-exp), aggz (aggregate Z).
+
+    ``fold`` > 1 LANE-FOLDS that many independent verification items into
+    ONE program (names prefixed ``i{t}.``): a single item's instruction-
+    level parallelism saturates only ~1/3 of the mul lanes (the schedule is
+    depth-bound), so folding F items multiplies per-step ILP by F and cuts
+    per-item step count almost F-fold until the work bound is reached."""
+    prog = Prog()
+    if fold == 1:
+        _emit_miller_product(prog, "", k_pubkeys)
+    else:
+        for t in range(fold):
+            _emit_miller_product(prog, f"i{t}.", k_pubkeys)
     return prog
 
 
-def build_aggregate_verify_miller(k_pairs: int) -> Prog:
-    """PROG A variant for AggregateVerify: prod_i e(pk_i, H(m_i)) * e(-g1, sig).
-    Pubkeys PROJECTIVE so inactive lanes can pass infinity (0:1:0), whose
-    Miller factor lands in a proper subfield and is killed by the final
-    exponentiation."""
-    prog = Prog()
+def _emit_aggregate_verify_miller(prog: Prog, ns: str, k_pairs: int) -> None:
     one = prog.const(1)
     f = None
     for j in range(k_pairs):
-        pxyz = (prog.inp(f"pk{j}.x"), prog.inp(f"pk{j}.y"), prog.inp(f"pk{j}.z"))
-        hx = f2_inputs(prog, f"h{j}.x")
-        hy = f2_inputs(prog, f"h{j}.y")
+        pxyz = (
+            prog.inp(f"{ns}pk{j}.x"),
+            prog.inp(f"{ns}pk{j}.y"),
+            prog.inp(f"{ns}pk{j}.z"),
+        )
+        hx = f2_inputs(prog, f"{ns}h{j}.x")
+        hy = f2_inputs(prog, f"{ns}h{j}.y")
         fj = miller_loop(prog, (hx, hy), pxyz)
         f = fj if f is None else f12_mul(prog, f, fj)
-    sx = f2_inputs(prog, "sig.x")
-    sy = f2_inputs(prog, "sig.y")
+    sx = f2_inputs(prog, f"{ns}sig.x")
+    sy = f2_inputs(prog, f"{ns}sig.y")
     ng = (prog.const(_G1_X), prog.const((-_G1_Y) % P), one)
     f2_ = miller_loop(prog, (sx, sy), ng)
     f = f12_mul(prog, f, f2_)
     for i in range(12):
-        prog.out(f[i], f"f.{i}")
+        prog.out(f[i], f"{ns}f.{i}")
+
+
+def build_aggregate_verify_miller(k_pairs: int, fold: int = 1) -> Prog:
+    """PROG A variant for AggregateVerify: prod_i e(pk_i, H(m_i)) * e(-g1, sig).
+    Pubkeys PROJECTIVE so inactive lanes can pass infinity (0:1:0), whose
+    Miller factor lands in a proper subfield and is killed by the final
+    exponentiation. ``fold`` as in build_miller_product."""
+    prog = Prog()
+    if fold == 1:
+        _emit_aggregate_verify_miller(prog, "", k_pairs)
+    else:
+        for t in range(fold):
+            _emit_aggregate_verify_miller(prog, f"i{t}.", k_pairs)
     return prog
 
 
-def build_hard_part() -> Prog:
-    """PROG B: HHT hard part on unitary g (12 inputs), outputs res (12).
-    res == 1 iff g^((p^4-p^2+1)/r) == 1."""
-    prog = Prog()
-    g = [prog.inp(f"g.{i}") for i in range(12)]
+def _emit_hard_part(prog: Prog, ns: str) -> None:
+    g = [prog.inp(f"{ns}g.{i}") for i in range(12)]
 
     t0 = f12_pow_x_minus_1(prog, f12_pow_x_minus_1(prog, g))  # g^((x-1)^2)
     t1 = f12_mul(prog, f12_pow_x(prog, t0), f12_frobenius(prog, t0, 1))
@@ -518,5 +547,20 @@ def build_hard_part() -> Prog:
     t2 = f12_mul(prog, t2, f12_conj(prog, t1))
     res = f12_mul(prog, t2, f12_mul(prog, f12_square(prog, g), g))
     for i in range(12):
-        prog.out(res[i], f"res.{i}")
+        prog.out(res[i], f"{ns}res.{i}")
+
+
+def build_hard_part(fold: int = 1) -> Prog:
+    """PROG B: HHT hard part on unitary g (12 inputs), outputs res (12).
+    res == 1 iff g^((p^4-p^2+1)/r) == 1.
+
+    The single-item schedule is severely depth-bound (~7% mul-lane
+    utilization: long serial cyclotomic-squaring chains), so ``fold`` here
+    is the big lever — 16 items per program saturate the lanes."""
+    prog = Prog()
+    if fold == 1:
+        _emit_hard_part(prog, "")
+    else:
+        for t in range(fold):
+            _emit_hard_part(prog, f"i{t}.")
     return prog

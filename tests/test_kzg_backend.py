@@ -65,3 +65,18 @@ def test_identity_commitment_edge(setup):
     )
     assert bool(got[0]) == kzg.verify_point_proof(setup, commitment, proof, 4, y)
     assert bool(got[0])
+
+
+def test_tau_query_oracle_fallback(setup):
+    # z == tau: [tau - z]G2 is the point at infinity, which has no affine
+    # form — the device path must answer that item via the oracle fallback
+    # (and the all-fallback batch shape must not touch the device at all)
+    coeffs = [3, 1, 4, 1, 5]
+    commitment = kzg.commit_to_poly(setup, coeffs)
+    tau = 0x5EED
+    proof, y = kzg.prove_at_point(setup, coeffs, z=tau)
+    got = kzg_backend.batch_verify_point_proofs(
+        setup, [commitment], [proof], [tau], [y]
+    )
+    want = kzg.verify_point_proof(setup, commitment, proof, tau, y)
+    assert bool(got[0]) == want
