@@ -351,10 +351,11 @@ class Program:
         out = np.zeros(tuple(batch_shape) + (n_in, fq.NUM_LIMBS), dtype=np.uint32)
         for idx, name in enumerate(self.input_names):
             v = np.asarray(values[name], dtype=np.uint64)
-            if v.size and int(v.max()) >> 32:
+            if v.size and int(v.max()) >> fq.LIMB_BITS:
                 raise ValueError(
-                    f"input {name!r} has limbs >= 2^32 — program inputs must "
-                    "be canonical Montgomery residues (limbs < 2^28)"
+                    f"input {name!r} has limbs >= 2^{fq.LIMB_BITS} — program "
+                    "inputs must be canonical Montgomery residues (the "
+                    "assembler's bound tracking assumes canonical magnitude)"
                 )
             out[..., idx, :] = v
         return out

@@ -237,3 +237,96 @@ def test_process_light_client_update_timeout_forces_best(spec, state):
     )
     assert store.snapshot.header == strong.header  # most participation won
     assert len(store.valid_updates) == 0
+
+
+@with_phases([ALTAIR])
+@with_presets([MINIMAL], reason="pure-python sync committee signing")
+@spec_state_test
+def test_validate_update_skipping_period_rejected(spec, state):
+    # an update more than one sync-committee period ahead of the snapshot
+    # cannot be validated (sync-protocol.md: update_period must be the
+    # snapshot's or the next one)
+    transition_to(spec, state, state.slot + 2)
+    snapshot = _snapshot_for(spec, state, header=_current_header(spec, state))
+
+    period_slots = int(spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD) * int(
+        spec.SLOTS_PER_EPOCH
+    )
+    far_header = spec.BeaconBlockHeader(
+        slot=state.slot + 2 * period_slots,
+        state_root=spec.Root(b"\x99" * 32),
+    )
+    committee_indices = get_committee_indices(spec, state)
+    nsc_branch, fin_branch = _empty_branches(spec)
+    update = spec.LightClientUpdate(
+        header=far_header,
+        next_sync_committee=state.next_sync_committee,
+        next_sync_committee_branch=nsc_branch,
+        finality_header=spec.BeaconBlockHeader(),
+        finality_branch=fin_branch,
+        sync_committee_bits=[True] * int(spec.SYNC_COMMITTEE_SIZE),
+        sync_committee_signature=_sign_header(spec, state, far_header, committee_indices),
+        fork_version=state.fork.current_version,
+    )
+    expect_assertion_error(
+        lambda: spec.validate_light_client_update(
+            snapshot, update, state.genesis_validators_root
+        )
+    )
+
+
+@with_phases([ALTAIR])
+@with_presets([MINIMAL], reason="pure-python sync committee signing")
+@spec_state_test
+def test_validate_update_insufficient_participation_rejected(spec, state):
+    # fewer than MIN_SYNC_COMMITTEE_PARTICIPANTS set bits fails before any
+    # signature work
+    transition_to(spec, state, state.slot + 2)
+    snapshot = _snapshot_for(spec, state)
+    update_header = _current_header(spec, state)
+    nsc_branch, fin_branch = _empty_branches(spec)
+    bits = [False] * int(spec.SYNC_COMMITTEE_SIZE)
+    update = spec.LightClientUpdate(
+        header=update_header,
+        next_sync_committee=state.next_sync_committee,
+        next_sync_committee_branch=nsc_branch,
+        finality_header=spec.BeaconBlockHeader(),
+        finality_branch=fin_branch,
+        sync_committee_bits=bits,
+        sync_committee_signature=spec.G2_POINT_AT_INFINITY,
+        fork_version=state.fork.current_version,
+    )
+    expect_assertion_error(
+        lambda: spec.validate_light_client_update(
+            snapshot, update, state.genesis_validators_root
+        )
+    )
+
+
+@with_phases([ALTAIR])
+@with_presets([MINIMAL], reason="pure-python sync committee signing")
+@spec_state_test
+def test_validate_update_nonzero_committee_branch_same_period_rejected(spec, state):
+    # inside the snapshot's own period the next-sync-committee branch MUST be
+    # zeroed — a real-looking branch is a malformed update, not a bonus proof
+    transition_to(spec, state, state.slot + 2)
+    snapshot = _snapshot_for(spec, state)
+    update_header = _current_header(spec, state)
+    committee_indices = get_committee_indices(spec, state)
+    nsc_branch, fin_branch = _empty_branches(spec)
+    nsc_branch = [spec.Bytes32(b"\x01" * 32)] + nsc_branch[1:]
+    update = spec.LightClientUpdate(
+        header=update_header,
+        next_sync_committee=state.next_sync_committee,
+        next_sync_committee_branch=nsc_branch,
+        finality_header=spec.BeaconBlockHeader(),
+        finality_branch=fin_branch,
+        sync_committee_bits=[True] * int(spec.SYNC_COMMITTEE_SIZE),
+        sync_committee_signature=_sign_header(spec, state, update_header, committee_indices),
+        fork_version=state.fork.current_version,
+    )
+    expect_assertion_error(
+        lambda: spec.validate_light_client_update(
+            snapshot, update, state.genesis_validators_root
+        )
+    )

@@ -179,3 +179,52 @@ def test_is_sync_committee_aggregator_threshold(spec, state):
         assert hits == trials  # everyone aggregates on the minimal shape
     else:
         assert 0 < hits < trials
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+def test_compute_subnets_period_boundary_lookahead(spec, state):
+    # at the LAST slot of a sync-committee period, subnet duties come from
+    # the NEXT committee (validator.md lookahead: next_slot_epoch decides)
+    from ...helpers.state import transition_to
+
+    period_epochs = int(spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD)
+    slots_per_epoch = int(spec.SLOTS_PER_EPOCH)
+    current_epoch = int(spec.get_current_epoch(state))
+    boundary_epoch = (current_epoch // period_epochs + 1) * period_epochs
+    transition_to(spec, state, boundary_epoch * slots_per_epoch - 1)
+
+    sub_size = int(spec.SYNC_COMMITTEE_SIZE) // int(spec.SYNC_COMMITTEE_SUBNET_COUNT)
+    for index in range(len(state.validators)):
+        subnets = spec.compute_subnets_for_sync_committee(state, index)
+        pubkey = state.validators[index].pubkey
+        expected = {
+            spec.uint64(seat // sub_size)
+            for seat, pk in enumerate(state.next_sync_committee.pubkeys)
+            if pk == pubkey
+        }
+        assert set(int(s) for s in subnets) == set(int(s) for s in expected)
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+@always_bls
+def test_sync_committee_message_binds_slot(spec, state):
+    # the signing data covers the slot's epoch domain AND the block root:
+    # messages for different roots must differ; same (root, epoch) agree
+    index = 3
+    root_a = spec.Root(b"\x11" * 32)
+    root_b = spec.Root(b"\x22" * 32)
+    m_same_epoch = spec.get_sync_committee_message(
+        state, root_a, index, privkeys[index]
+    )
+    m_same_epoch2 = spec.get_sync_committee_message(
+        state, root_a, index, privkeys[index]
+    )
+    m_other_root = spec.get_sync_committee_message(
+        state, root_b, index, privkeys[index]
+    )
+    assert m_same_epoch.signature == m_same_epoch2.signature
+    assert m_same_epoch.signature != m_other_root.signature
+    assert int(m_same_epoch.validator_index) == index
+    assert m_same_epoch.slot == state.slot

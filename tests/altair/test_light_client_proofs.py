@@ -1,0 +1,1 @@
+from consensus_specs_tpu.test.altair.unittests.test_light_client_proofs import *  # noqa: F401,F403

@@ -11,9 +11,12 @@ from consensus_specs_tpu.utils import kzg  # noqa: E402
 from consensus_specs_tpu.ops import kzg_backend  # noqa: E402
 
 
+TAU = 0x5EED  # the module setup's secret — shared so the z==tau test binds
+
+
 @pytest.fixture(scope="module")
 def setup():
-    return kzg.lazy_setup(tau=0x5EED, n=16)
+    return kzg.lazy_setup(tau=TAU, n=16)
 
 
 def _cases(setup, count=3):
@@ -73,10 +76,12 @@ def test_tau_query_oracle_fallback(setup):
     # (and the all-fallback batch shape must not touch the device at all)
     coeffs = [3, 1, 4, 1, 5]
     commitment = kzg.commit_to_poly(setup, coeffs)
-    tau = 0x5EED
-    proof, y = kzg.prove_at_point(setup, coeffs, z=tau)
+    # the scenario's whole point: [tau - z]G2 degenerates to infinity
+    h0 = O.ec_add(setup.g2[1], O.ec_neg(O.ec_mul(O.G2_GEN, TAU)))
+    assert O.ec_to_affine(h0) is None
+    proof, y = kzg.prove_at_point(setup, coeffs, z=TAU)
     got = kzg_backend.batch_verify_point_proofs(
-        setup, [commitment], [proof], [tau], [y]
+        setup, [commitment], [proof], [TAU], [y]
     )
-    want = kzg.verify_point_proof(setup, commitment, proof, tau, y)
+    want = kzg.verify_point_proof(setup, commitment, proof, TAU, y)
     assert bool(got[0]) == want

@@ -19,11 +19,14 @@ i=0
 while true; do
   i=$((i + 1))
   echo "=== attempt $i epoch $(date -u +%H:%M:%S) ===" >> "$OUT"
+  ATT=$(mktemp)
   CONSENSUS_SPECS_TPU_BENCH_CHILD=1 BENCH_MODE=epoch \
-    timeout 900 python bench.py >> "$OUT" 2>/dev/null
-  if tail -5 "$OUT" | grep -q '"platform": "axon"\|"platform": "tpu"'; then
+    timeout 900 python bench.py > "$ATT" 2>/dev/null
+  cat "$ATT" >> "$OUT"
+  if grep -q '"platform": "axon"\|"platform": "tpu"' "$ATT"; then
     echo "=== attempt $i probe $(date -u +%H:%M:%S) ===" >> "$OUT"
     timeout 650 python tools/tpu_probe.py >> "$OUT" 2>&1
   fi
+  rm -f "$ATT"
   sleep 10
 done
