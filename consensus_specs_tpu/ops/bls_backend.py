@@ -104,8 +104,8 @@ def _fold_for(kind: str, k: int, n_items: int = 1 << 30) -> int:
     never exceeding the batch itself (a single verify must not pay for a
     mostly-filler folded program)."""
     if kind == "hard_part":
-        table = 16
-    elif k <= 64:
+        table = 32
+    elif k <= 160:
         table = 8
     elif k <= 256:
         table = 4
