@@ -413,14 +413,15 @@ import functools as _functools
 
 @_functools.lru_cache(maxsize=8)
 def _vm_run_for_mesh(mesh):
-    """Jitted VM runner with the leading batch axis sharded over ``mesh``
-    (the DP axis of SURVEY.md §2.7/P1) and the instruction stream replicated.
-    The scan body is purely batch-elementwise, so GSPMD partitions it with
-    zero collectives — each device runs its slice of the verification batch."""
+    """Jitted VM runner with the leading batch axis sharded over ALL of
+    ``mesh``'s axes (the DP axis of SURVEY.md §2.7/P1 — a hierarchical
+    host x chip / DCN x ICI mesh flattens onto the one batch dimension) and
+    the instruction stream replicated. The scan body is purely
+    batch-elementwise, so GSPMD partitions it with zero collectives — each
+    device runs its slice of the verification batch."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    axis = mesh.axis_names[0]
-    batch_sh = NamedSharding(mesh, P(axis))
+    batch_sh = NamedSharding(mesh, P(mesh.axis_names))
     repl = NamedSharding(mesh, P())
     return jax.jit(
         _vm_body,
@@ -440,8 +441,8 @@ def execute(program: Program, inputs: Dict[str, np.ndarray], batch_shape=(),
     """Run an assembled program. Input arrays must be canonical Montgomery
     limb arrays of shape batch_shape + (NUM_LIMBS,). Returns named outputs
     (loose, bounded < 2^382). With ``mesh``, the leading batch axis is
-    sharded over the mesh's first axis (batch_shape[0] must divide by its
-    size)."""
+    sharded over ALL the mesh's axes (batch_shape[0] must divide by the
+    total device count)."""
     from . import profiling
 
     stacked = program.stack_inputs(inputs, tuple(batch_shape))
@@ -474,8 +475,7 @@ def _execute_device(stacked, template, input_regs, output_regs, instr, mesh):
         )
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    axis = mesh.axis_names[0]
-    batch_sh = NamedSharding(mesh, P(axis))
+    batch_sh = NamedSharding(mesh, P(mesh.axis_names))
     repl = NamedSharding(mesh, P())
     stacked_d = jax.device_put(jnp.asarray(stacked), batch_sh)
     args_d = tuple(
