@@ -5,9 +5,9 @@ reference's rewards test tree, written for this harness)."""
 from random import Random
 
 from ...context import (
-    low_balances, misc_balances, spec_state_test, spec_test,
-    with_all_phases, with_custom_state, default_activation_threshold,
-    zero_activation_threshold,
+    PHASE0, low_balances, misc_balances, spec_state_test, spec_test,
+    with_all_phases, with_custom_state, with_phases,
+    default_activation_threshold, zero_activation_threshold,
 )
 from ...helpers.attestations import next_epoch_with_attestations
 from ...helpers.rewards import run_deltas, run_deltas_at_boundary
@@ -360,3 +360,142 @@ def test_random_attestations_misc_balances(spec, state):
 
     state = _attested_state(spec, state, participation_fn=sample)
     yield from run_deltas_at_boundary(spec, state)
+
+
+# -- pending-attestation surgery scenarios (phase0: the queues are plain
+#    state fields, so vote-shape and delay matrices are direct edits) ------
+
+
+def _surgeried_state(spec, state, mutate):
+    """An attested state whose previous-epoch pending attestations have been
+    reshaped by ``mutate(pending_list)`` before the rewards pass runs."""
+    state = _attested_state(spec, state)
+    mutate(state.previous_epoch_attestations)
+    return state
+
+
+@with_phases([PHASE0])
+@spec_state_test
+def test_inclusion_delay_min_all(spec, state):
+    # every vote lands at the minimum delay: maximal proposer+delay rewards
+    def m(pending):
+        for att in pending:
+            att.inclusion_delay = spec.MIN_ATTESTATION_INCLUSION_DELAY
+    yield from run_deltas_at_boundary(spec, state=_surgeried_state(spec, state, m))
+
+
+@with_phases([PHASE0])
+@spec_state_test
+def test_inclusion_delay_max_all(spec, state):
+    # every vote lands at the last allowed slot: the delay reward floors
+    # (base_reward // SLOTS_PER_EPOCH), never negative
+    def m(pending):
+        for att in pending:
+            att.inclusion_delay = spec.SLOTS_PER_EPOCH
+    yield from run_deltas_at_boundary(spec, state=_surgeried_state(spec, state, m))
+
+
+@with_phases([PHASE0])
+@spec_state_test
+def test_inclusion_delay_mixed(spec, state):
+    # a spread of delays: the engine's min-delay-per-attester selection
+    # (earliest inclusion wins) is what the spec pays
+    def m(pending):
+        for i, att in enumerate(pending):
+            att.inclusion_delay = 1 + (i * 5) % int(spec.SLOTS_PER_EPOCH)
+    yield from run_deltas_at_boundary(spec, state=_surgeried_state(spec, state, m))
+
+
+@with_phases([PHASE0])
+@spec_state_test
+def test_duplicate_pending_same_attester(spec, state):
+    # the same vote recorded twice with different delays: each attester is
+    # paid once, at the MINIMUM delay of its matching records
+    def m(pending):
+        dup = pending[0].copy()
+        dup.inclusion_delay = spec.SLOTS_PER_EPOCH
+        pending.append(dup)
+    yield from run_deltas_at_boundary(spec, state=_surgeried_state(spec, state, m))
+
+
+@with_phases([PHASE0])
+@spec_state_test
+def test_correct_target_incorrect_head(spec, state):
+    # head votes miss (wrong beacon_block_root) but targets hold: head
+    # component penalizes everyone, target/source still reward
+    def m(pending):
+        for att in pending:
+            att.data.beacon_block_root = spec.Root(b"\x36" * 32)
+    yield from run_deltas_at_boundary(spec, state=_surgeried_state(spec, state, m))
+
+
+@with_phases([PHASE0])
+@spec_state_test
+def test_incorrect_target_all(spec, state):
+    # target votes miss: target AND head components penalize (head matching
+    # requires target matching in the engine's filtered sets)
+    def m(pending):
+        for att in pending:
+            att.data.target.root = spec.Root(b"\x37" * 32)
+    yield from run_deltas_at_boundary(spec, state=_surgeried_state(spec, state, m))
+
+
+@with_phases([PHASE0])
+@spec_state_test
+def test_half_incorrect_target_half_incorrect_head(spec, state):
+    def m(pending):
+        for i, att in enumerate(pending):
+            if i % 2 == 0:
+                att.data.target.root = spec.Root(b"\x38" * 32)
+            else:
+                att.data.beacon_block_root = spec.Root(b"\x39" * 32)
+    yield from run_deltas_at_boundary(spec, state=_surgeried_state(spec, state, m))
+
+
+@with_phases([PHASE0])
+@spec_state_test
+def test_correct_target_incorrect_head_leak(spec, state):
+    _leaking_state(spec, state)
+    _, _, state = next_epoch_with_attestations(spec, state, False, True)
+    assert spec.is_in_inactivity_leak(state)
+    for att in state.previous_epoch_attestations:
+        att.data.beacon_block_root = spec.Root(b"\x3a" * 32)
+    yield from run_deltas_at_boundary(spec, state)
+
+
+@with_phases([PHASE0])
+@spec_state_test
+def test_incorrect_target_all_leak(spec, state):
+    # during a leak, wrong-target voters take the full inactivity penalty
+    # as if absent
+    _leaking_state(spec, state)
+    _, _, state = next_epoch_with_attestations(spec, state, False, True)
+    assert spec.is_in_inactivity_leak(state)
+    for att in state.previous_epoch_attestations:
+        att.data.target.root = spec.Root(b"\x3b" * 32)
+    yield from run_deltas_at_boundary(spec, state)
+
+
+@with_phases([PHASE0])
+@spec_state_test
+def test_single_proposer_concentration(spec, state):
+    # all inclusion credit routed to one proposer: its reward accumulates
+    # per attester while other proposers get nothing
+    def m(pending):
+        for att in pending:
+            att.proposer_index = 1
+    yield from run_deltas_at_boundary(spec, state=_surgeried_state(spec, state, m))
+
+
+@with_phases([PHASE0])
+@spec_state_test
+def test_empty_bits_pending_attestation(spec, state):
+    # a pending attestation with no participants contributes to no one —
+    # present-but-empty records must not crash or reward
+    def m(pending):
+        ghost = pending[0].copy()
+        ghost.aggregation_bits = type(ghost.aggregation_bits)(
+            [0] * len(ghost.aggregation_bits)
+        )
+        pending.append(ghost)
+    yield from run_deltas_at_boundary(spec, state=_surgeried_state(spec, state, m))
