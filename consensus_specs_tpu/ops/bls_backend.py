@@ -211,26 +211,56 @@ _G2GEN_LIMBS = np.stack(
 _G2_COMPS = ("x.0", "x.1", "y.0", "y.1")
 
 
-@functools.lru_cache(maxsize=1 << 20)
-def _pubkey_limbs(pk: bytes) -> Tuple[np.ndarray, np.ndarray]:
-    """KeyValidate + Montgomery-encode; raises ValueError on failure.
-    Cached: validator pubkeys repeat across every slot of an epoch."""
+def _pubkey_limbs_compute(pk: bytes):
+    """KeyValidate + Montgomery-encode; failures are returned as ValueError
+    VALUES (so prewarm workers can ship them back across the pool)."""
     aff = O.g1_from_bytes(pk)
     if aff is None:
-        raise ValueError("pubkey is the point at infinity")
+        return ValueError("pubkey is the point at infinity")
     if not O.is_in_g1_subgroup(O.ec_from_affine(aff)):
-        raise ValueError("pubkey not in G1 subgroup")
+        return ValueError("pubkey not in G1 subgroup")
     return fq.to_mont_int(aff[0].n), fq.to_mont_int(aff[1].n)
 
 
-@functools.lru_cache(maxsize=1 << 16)
-def _signature_limbs(sig: bytes) -> np.ndarray:
-    """(4, L) stacked (x.0, x.1, y.0, y.1) Montgomery limbs."""
+def _pubkey_limbs(pk: bytes) -> Tuple[np.ndarray, np.ndarray]:
+    """Cached: validator pubkeys repeat across every slot of an epoch."""
+    return _cached(_PK_CACHE, pk, _pubkey_limbs_compute)
+
+
+_SIG_CACHE: Dict[bytes, object] = {}
+_MSG_CACHE: Dict[bytes, np.ndarray] = {}
+_PK_CACHE: Dict[bytes, object] = {}
+# pubkeys get the big cache: a mainnet validator set is ~1M keys and they
+# repeat every slot; messages/signatures churn per epoch
+_CACHE_CAPS = {id(_SIG_CACHE): 1 << 16, id(_MSG_CACHE): 1 << 16,
+               id(_PK_CACHE): 1 << 20}
+
+
+def _cached(cache: Dict, key: bytes, compute):
+    """Shared accessor: compute fns RETURN a ValueError value on validation
+    failure (so pool workers can ship it); only successes are cached —
+    attacker-supplied invalid inputs can neither occupy slots nor force the
+    eviction wipe — and the result/raise semantics stay uniform."""
+    v = cache.get(key)
+    if v is None:
+        v = compute(key)
+        if not isinstance(v, ValueError):
+            if len(cache) >= _CACHE_CAPS[id(cache)]:
+                cache.clear()  # rare: that many DISTINCT valid inputs
+            cache[key] = v
+    if isinstance(v, ValueError):
+        raise v
+    return v
+
+
+def _signature_limbs_compute(sig: bytes):
+    """(4, L) stacked Montgomery limbs, or the ValueError to re-raise —
+    exceptions are VALUES here so prewarm workers can ship them back."""
     aff = O.g2_from_bytes(sig)
     if aff is None:
-        raise ValueError("signature is the point at infinity")
+        return ValueError("signature is the point at infinity")
     if not O.is_in_g2_subgroup(O.ec_from_affine(aff)):
-        raise ValueError("signature not in G2 subgroup")
+        return ValueError("signature not in G2 subgroup")
     x, y = aff
     return np.stack(
         [
@@ -242,9 +272,11 @@ def _signature_limbs(sig: bytes) -> np.ndarray:
     )
 
 
-@functools.lru_cache(maxsize=1 << 16)
-def _message_limbs(message: bytes) -> np.ndarray:
-    """(4, L) stacked hash-to-G2 point limbs."""
+def _signature_limbs(sig: bytes) -> np.ndarray:
+    return _cached(_SIG_CACHE, sig, _signature_limbs_compute)
+
+
+def _message_limbs_compute(message: bytes) -> np.ndarray:
     x, y = O.ec_to_affine(O.hash_to_g2(message, DST))
     return np.stack(
         [
@@ -254,6 +286,76 @@ def _message_limbs(message: bytes) -> np.ndarray:
             fq.to_mont_int(y.c1),
         ]
     )
+
+
+def _message_limbs(message: bytes) -> np.ndarray:
+    """(4, L) stacked hash-to-G2 point limbs (dict-cached; prewarmable)."""
+    return _cached(_MSG_CACHE, message, _message_limbs_compute)
+
+
+_PREWARM_FNS = {
+    "msg": _message_limbs_compute,
+    "sig": _signature_limbs_compute,
+    "pk": _pubkey_limbs_compute,
+}
+
+
+def _prewarm_worker(args):
+    kind, payload = args
+    try:
+        return kind, payload, _PREWARM_FNS[kind](payload)
+    except Exception:
+        # TRANSIENT worker failure (validation failures come back as
+        # ValueError VALUES from the compute fn): don't poison the cache,
+        # let the serial item loop recompute
+        return kind, payload, None
+
+
+def prewarm_host_caches(messages: Sequence[bytes], signatures: Sequence[bytes],
+                        pubkeys: Sequence[bytes] = ()):
+    """Fill the hash-to-G2, signature-decode, and pubkey caches with a
+    process pool.
+
+    The per-item host prep is pure-Python big-int work (hash_to_curve ~29 ms,
+    decode+subgroup ~8 ms) that would otherwise serialize an epoch's ~2k
+    distinct messages into minutes of single-core time before the device
+    sees a single byte. Pool size: CONSENSUS_SPECS_TPU_HASH_PROCS (default
+    min(8, cpus)); any pool failure falls back to the serial path."""
+    work = [("msg", m) for m in set(messages) if m not in _MSG_CACHE]
+    work += [("sig", s) for s in set(signatures) if s not in _SIG_CACHE]
+    work += [("pk", p) for p in set(pubkeys) if p not in _PK_CACHE]
+    if len(work) < 16:
+        return
+    procs = int(
+        os.environ.get(
+            "CONSENSUS_SPECS_TPU_HASH_PROCS", str(min(8, os.cpu_count() or 1))
+        )
+    )
+    if procs <= 1:
+        return
+    try:
+        import multiprocessing as mp
+
+        # 'fork' after jax initialization carries a documented deadlock
+        # hazard (children inherit runtime locks); the workers are pure
+        # Python, but guard with a deadline anyway — a hung pool must
+        # degrade to the serial path, not block verification forever
+        ctx = mp.get_context(os.environ.get("CONSENSUS_SPECS_TPU_HASH_MP_CTX",
+                                            "fork"))
+        deadline = max(120.0, 0.2 * len(work))
+        with ctx.Pool(procs) as pool:
+            results = pool.map_async(_prewarm_worker, work, chunksize=8)
+            for kind, payload, value in results.get(timeout=deadline):
+                if value is None:
+                    continue  # transient worker failure: recompute serially
+                cache = {"msg": _MSG_CACHE, "sig": _SIG_CACHE,
+                         "pk": _PK_CACHE}[kind]
+                if not isinstance(value, ValueError) and (
+                    len(cache) < _CACHE_CAPS[id(cache)]
+                ):
+                    cache[payload] = value
+    except Exception:
+        pass  # serial fallback: the item loop computes on demand
 
 
 def _flat_ints_to_oracle(coeffs: Sequence[int]) -> O.Fq12:
@@ -382,6 +484,11 @@ def batch_fast_aggregate_verify(
 
     lay = _FoldLayout("miller_product", k, n, mesh)
     prA, fold, rows, nb = lay.program, lay.fold, lay.rows, lay.nb
+    prewarm_host_caches(
+        [bytes(m) for m in messages],
+        [bytes(s) for s in signatures],
+        [bytes(pk) for pks in pubkey_sets for pk in pks],
+    )
 
     # stacked staging arrays (vectorized — the per-name dict assignment loop
     # was ~1.5 s of host time at epoch scale); inactive-lane fillers:
@@ -466,6 +573,11 @@ def batch_aggregate_verify(
 
     lay = _FoldLayout("aggregate_verify", k, n, mesh)
     prA, fold, rows, nb = lay.program, lay.fold, lay.rows, lay.nb
+    prewarm_host_caches(
+        [bytes(m) for ms in message_lists for m in ms],
+        [bytes(s) for s in signatures],
+        [bytes(pk) for pks in pubkey_lists for pk in pks],
+    )
 
     precheck = np.zeros(nb, dtype=bool)
     pk_x = np.zeros((nb, k, L), dtype=np.uint64)

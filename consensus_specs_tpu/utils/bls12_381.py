@@ -486,6 +486,21 @@ def is_in_g1_subgroup(pt) -> bool:
 
 
 def is_in_g2_subgroup(pt) -> bool:
+    """G2 membership via the psi-endomorphism criterion (Scott, 'A note on
+    group membership tests'): P is in the order-r subgroup of E'(Fq2) iff
+    psi(P) == [x]P, x the (negative) BLS parameter. One 64-bit scalar
+    multiply instead of a 255-bit one; agrees with the definitional
+    [r]P == infinity check on every tested member and non-member
+    (tests/test_bls.py)."""
+    if ec_to_affine(pt) is None:
+        return True
+    return ec_to_affine(psi_g2(pt)) == ec_to_affine(
+        ec_neg(ec_mul(pt, -X_PARAM))
+    )
+
+
+def _is_in_g2_subgroup_scalar(pt) -> bool:
+    """The definitional path — kept as the cross-check oracle."""
     return ec_mul(pt, R) is None
 
 
@@ -815,7 +830,52 @@ def iso_map_g2(x: Fq2, y: Fq2) -> Tuple[Fq2, Fq2]:
     return (x_num * x_den.inverse(), y * y_num * y_den.inverse())
 
 
+# psi endomorphism on the twist E'(Fq2): untwist -> Frobenius -> twist.
+# psi(x, y) = (PSI_CX * conj(x), PSI_CY * conj(y)); constants are
+# 1/xi^((p-1)/3) and 1/xi^((p-1)/2) for the M-twist xi = 1 + u.
+_PSI_CX = XI.pow((P - 1) // 3).inverse()
+_PSI_CY = XI.pow((P - 1) // 2).inverse()
+
+
+def psi_g2(pt):
+    """The p-power endomorphism on E'(Fq2) (affine in, affine out as a
+    Jacobian with Z=1 for composition with the ec_* ops)."""
+    aff = ec_to_affine(pt)
+    if aff is None:
+        return pt
+    x, y = aff
+    return ec_from_affine((_PSI_CX * x.conjugate(), _PSI_CY * y.conjugate()))
+
+
+_X_ABS = 0xD201000000010000  # |x|, the BLS parameter magnitude (x = -|x|)
+
+
 def clear_cofactor_g2(pt):
+    """[H_EFF_G2] * pt via the psi-endomorphism decomposition
+    (Budroni-Pintore; RFC 9380 picked H_EFF_G2 so that
+
+        [h_eff]P = [x^2 - x - 1]P + [x - 1]psi(P) + psi(psi(2P))
+
+    holds EXACTLY for every point of E'(Fq2), not just the subgroup).
+    Replaces the 636-bit scalar multiply with three 64-bit multiplies —
+    ~6x faster, bit-identical (cross-checked against the scalar-multiply
+    path in tests/test_bls.py)."""
+    t1 = ec_mul(pt, _X_ABS)          # [-x]P
+    txx = ec_mul(t1, _X_ABS)         # [x^2]P
+    psi_p = psi_g2(pt)
+    t2 = ec_mul(psi_p, _X_ABS)       # [-x]psi(P)
+    psi2_2p = psi_g2(psi_g2(ec_double(pt)))
+    # [x^2 - x - 1]P = txx + t1 - P;  [x - 1]psi(P) = -t2 - psi(P)
+    acc = ec_add(txx, t1)
+    acc = ec_add(acc, ec_neg(pt))
+    acc = ec_add(acc, ec_neg(t2))
+    acc = ec_add(acc, ec_neg(psi_p))
+    return ec_add(acc, psi2_2p)
+
+
+def _clear_cofactor_g2_scalar(pt):
+    """The definitional path (636-bit scalar multiply) — kept as the
+    cross-check oracle for clear_cofactor_g2."""
     return ec_mul(pt, H_EFF_G2)
 
 

@@ -225,3 +225,39 @@ def test_bls_switch_stubs():
         assert bls.Aggregate([]) == bls.STUB_SIGNATURE
     finally:
         bls.bls_active = True
+
+
+def test_psi_cofactor_clearing_matches_scalar_multiply():
+    # the Budroni-Pintore psi decomposition must equal the definitional
+    # [H_EFF_G2] scalar multiply on arbitrary E'(Fq2) points (pre-cofactor,
+    # outside the subgroup)
+    from consensus_specs_tpu.utils import bls12_381 as O
+
+    dst = b"BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_"
+    for i in range(4):
+        u0, u1 = O.hash_to_field_fq2(bytes([40 + i]) * 32, 2, dst)
+        q = O.ec_add(
+            O.ec_from_affine(O.iso_map_g2(*O.map_to_curve_sswu_g2(u0))),
+            O.ec_from_affine(O.iso_map_g2(*O.map_to_curve_sswu_g2(u1))),
+        )
+        fast = O.ec_to_affine(O.clear_cofactor_g2(q))
+        slow = O.ec_to_affine(O._clear_cofactor_g2_scalar(q))
+        assert fast == slow
+
+
+def test_psi_membership_matches_scalar_check():
+    # Scott's psi criterion must agree with [r]P == infinity on members
+    # (hash outputs, generator multiples) AND non-members (pre-cofactor
+    # curve points)
+    from consensus_specs_tpu.utils import bls12_381 as O
+
+    dst = b"BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_"
+    members = [O.hash_to_g2(bytes([i]) * 32, dst) for i in range(2)]
+    members += [O.ec_mul(O.G2_GEN, k) for k in (1, 987654321)]
+    for p in members:
+        assert O.is_in_g2_subgroup(p)
+        assert O._is_in_g2_subgroup_scalar(p)
+    for i in range(3):
+        u0, _ = O.hash_to_field_fq2(bytes([70 + i]) * 32, 2, dst)
+        q = O.ec_from_affine(O.iso_map_g2(*O.map_to_curve_sswu_g2(u0)))
+        assert O.is_in_g2_subgroup(q) == O._is_in_g2_subgroup_scalar(q)
