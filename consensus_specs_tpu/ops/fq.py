@@ -56,6 +56,8 @@ def limbs_to_int(limbs) -> int:
 P_LIMBS = _int_to_limbs_np(P)
 N0 = (-pow(P, -1, 1 << LIMB_BITS)) % (1 << LIMB_BITS)  # -p^-1 mod 2^29
 R_MOD_P = R_MONT % P
+R_INV = pow(R_MONT, -1, P)  # hoisted: a ~70us modular inverse per call adds
+# seconds at epoch scale (tens of thousands of from_mont_limbs calls)
 ONE_MONT = _int_to_limbs_np(R_MOD_P)  # 1 in Montgomery form
 ZERO = np.zeros(NUM_LIMBS, dtype=np.uint64)
 # MP: multiple of p used as the additive shift in borrowless subtraction;
@@ -77,7 +79,7 @@ def to_mont_int(x: int) -> np.ndarray:
 def from_mont_limbs(limbs) -> int:
     """Host: decode (possibly loose) Montgomery-form limbs to an int < p."""
     x = limbs_to_int(limbs)
-    return (x * pow(R_MONT, -1, P)) % P
+    return (x * R_INV) % P
 
 
 def _carry_limbs(t, out_limbs=NUM_LIMBS):

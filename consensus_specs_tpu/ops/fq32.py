@@ -59,8 +59,11 @@ def to_mont_int(x: int) -> np.ndarray:
     return _int_to_limbs_np((x * R_MONT) % P)
 
 
+R_INV = pow(R_MONT, -1, P)
+
+
 def from_mont_limbs(limbs) -> int:
-    return (limbs_to_int(limbs) * pow(R_MONT, -1, P)) % P
+    return (limbs_to_int(limbs) * R_INV) % P
 
 
 def _carry_limbs(t, out_limbs=NUM_LIMBS):
