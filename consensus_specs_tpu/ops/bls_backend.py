@@ -441,6 +441,67 @@ class _FoldLayout:
                 ins[ns + name_fn(*idx)] = v[(slice(None), t) + idx]
 
 
+def _easy_worker(f_coeffs):
+    """Pool-safe: easy part + Montgomery-encode; None for degenerate f."""
+    g = _easy_part_flat(f_coeffs)
+    if g is None:
+        return None
+    return np.stack([fq.to_mont_int(c) for c in g])
+
+
+def _easy_part_batch(out, lay, precheck, aggz: bool):
+    """Readback of PROG A outputs + the final-exponentiation easy part for
+    every active item, pooled across processes at epoch scale (the per-item
+    Fq12 inversion/frobenius work is ~1 ms of pure Python each). Returns
+    (g_batch, agg_nonzero | None); degenerate items clear their precheck
+    bit in place."""
+    nb = len(precheck)
+    L = fq.NUM_LIMBS
+    agg_nonzero = np.zeros(nb, dtype=bool) if aggz else None
+    coeffs = {}
+    for i in range(nb):
+        if not precheck[i]:
+            continue
+        r, ns = lay.split(i)
+        if aggz:
+            agg_nonzero[i] = fq.from_mont_limbs(out[f"{ns}aggz"][r]) != 0
+        coeffs[i] = [fq.from_mont_limbs(out[f"{ns}f.{j}"][r]) for j in range(12)]
+
+    results = {}
+    items = list(coeffs.items())
+    procs = int(
+        os.environ.get(
+            "CONSENSUS_SPECS_TPU_HASH_PROCS", str(min(8, os.cpu_count() or 1))
+        )
+    )
+    if len(items) >= 64 and procs > 1:
+        try:
+            import multiprocessing as mp
+
+            ctx = mp.get_context(
+                os.environ.get("CONSENSUS_SPECS_TPU_HASH_MP_CTX", "fork")
+            )
+            with ctx.Pool(procs) as pool:
+                async_res = pool.map_async(
+                    _easy_worker, [c for _, c in items], chunksize=16
+                )
+                for (i, _), g in zip(items, async_res.get(timeout=120.0)):
+                    results[i] = g
+        except Exception:
+            results = {}  # pool failed: recompute serially below
+    if not results:
+        for i, c in items:
+            results[i] = _easy_worker(c)
+
+    g_batch = np.zeros((nb, 12, L), dtype=np.uint64)
+    for i, g in results.items():
+        if g is None:
+            precheck[i] = False
+        else:
+            g_batch[i] = g
+    return g_batch, agg_nonzero
+
+
 def _run_hard_part(g_flat_batch: np.ndarray, mesh=None) -> np.ndarray:
     """(N, 12, L) unitary g limb batch -> (N,) bool (res == 1)."""
     n = g_flat_batch.shape[0]
@@ -532,22 +593,7 @@ def batch_fast_aggregate_verify(
 
     out = vm.execute(prA, ins, batch_shape=(rows,), mesh=mesh)
 
-    agg_nonzero = np.zeros(nb, dtype=bool)
-    g_batch = np.zeros((nb, 12, L), dtype=np.uint64)
-    for i in range(nb):
-        if not precheck[i]:
-            continue
-        r, ns = lay.split(i)
-        aggz = fq.from_mont_limbs(out[f"{ns}aggz"][r])
-        agg_nonzero[i] = aggz != 0
-        f_coeffs = [fq.from_mont_limbs(out[f"{ns}f.{j}"][r]) for j in range(12)]
-        g = _easy_part_flat(f_coeffs)
-        if g is None:
-            precheck[i] = False
-            continue
-        for j in range(12):
-            g_batch[i, j] = fq.to_mont_int(g[j])
-
+    g_batch, agg_nonzero = _easy_part_batch(out, lay, precheck, aggz=True)
     ok = _run_hard_part(g_batch, mesh=mesh)
     return (ok & precheck & agg_nonzero)[:n]
 
@@ -619,18 +665,7 @@ def batch_aggregate_verify(
     lay.scatter(ins, sg, lambda ci: f"sig.{_G2_COMPS[ci]}")
 
     out = vm.execute(prA, ins, batch_shape=(rows,), mesh=mesh)
-    g_batch = np.zeros((nb, 12, L), dtype=np.uint64)
-    for i in range(nb):
-        if not precheck[i]:
-            continue
-        r, ns = lay.split(i)
-        f_coeffs = [fq.from_mont_limbs(out[f"{ns}f.{j}"][r]) for j in range(12)]
-        g = _easy_part_flat(f_coeffs)
-        if g is None:
-            precheck[i] = False
-            continue
-        for j in range(12):
-            g_batch[i, j] = fq.to_mont_int(g[j])
+    g_batch, _ = _easy_part_batch(out, lay, precheck, aggz=False)
     ok = _run_hard_part(g_batch, mesh=mesh)
     return (ok & precheck)[:n]
 
