@@ -680,3 +680,162 @@ def test_expected_deposit_count_enforced(spec, state):
     )
     yield 'blocks', [spec.SignedBeaconBlock(message=block)]
     yield 'post', None
+
+
+@with_all_phases
+@spec_state_test
+def test_eth1_data_votes_no_consensus(spec, state):
+    # a full voting period with the vote split exactly 50/50: neither hash
+    # crosses the strict-majority bar, so eth1_data must NOT change
+    voting_period_slots = int(
+        spec.EPOCHS_PER_ETH1_VOTING_PERIOD * spec.SLOTS_PER_EPOCH
+    )
+    pre_eth1 = state.eth1_data.block_hash
+    offset_block = build_empty_block(spec, state, voting_period_slots - 1)
+    state_transition_and_sign_block(spec, state, offset_block)
+    yield 'pre', state
+
+    a, b = b'\xaa' * 32, b'\xbb' * 32
+    blocks = []
+    for i in range(voting_period_slots):
+        block = build_empty_block_for_next_slot(spec, state)
+        block.body.eth1_data.block_hash = a if i % 2 == 0 else b
+        blocks.append(state_transition_and_sign_block(spec, state, block))
+
+    assert state.eth1_data.block_hash == pre_eth1
+    yield 'blocks', blocks
+    yield 'post', state
+
+
+@with_all_phases
+@spec_state_test
+def test_double_validator_exit_same_block_rejected(spec, state):
+    # two exits for the SAME validator in one block: the second must hit
+    # the "is active and not yet exiting" assert
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    next_epoch(spec, state)  # past SHARD_COMMITTEE_PERIOD
+    exits = prepare_signed_exits(spec, state, [5])
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.voluntary_exits = exits + exits  # duplicate
+    yield 'pre', state
+    signed = sign_block(spec, state, block)
+    expect_assertion_error(
+        lambda: transition_unsigned_block(spec, state, block)
+    )
+    yield 'blocks', [signed]
+    yield 'post', None
+
+
+@with_all_phases
+@spec_state_test
+def test_duplicate_attester_slashing_same_block_rejected(spec, state):
+    # the same attester slashing twice: the second finds every index
+    # already slashed, so "some new validator slashed" fails
+    next_epoch(spec, state)
+    slashing = get_valid_attester_slashing(
+        spec, state, signed_1=True, signed_2=True
+    )
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.attester_slashings = [slashing, slashing]
+    yield 'pre', state
+    signed = sign_block(spec, state, block)
+    expect_assertion_error(
+        lambda: transition_unsigned_block(spec, state, block)
+    )
+    yield 'blocks', [signed]
+    yield 'post', None
+
+
+@with_all_phases
+@spec_state_test
+def test_historical_root_batch_crossed(spec, state):
+    # advance across a SLOTS_PER_HISTORICAL_ROOT boundary with real blocks
+    # at the edges: the accumulator must append exactly one HistoricalBatch
+    pre_len = len(state.historical_roots)
+    period = int(spec.SLOTS_PER_HISTORICAL_ROOT)
+    target = (int(state.slot) // period + 1) * period
+    yield 'pre', state
+    blocks = []
+    # one real block now, empty slots to just before the boundary epoch end,
+    # one real block after the crossing
+    block = build_empty_block_for_next_slot(spec, state)
+    blocks.append(state_transition_and_sign_block(spec, state, block))
+    from ...helpers.state import transition_to
+
+    transition_to(spec, state, target + 1)
+    block = build_empty_block_for_next_slot(spec, state)
+    blocks.append(state_transition_and_sign_block(spec, state, block))
+    assert len(state.historical_roots) == pre_len + 1
+    yield 'blocks', blocks
+    yield 'post', state
+
+
+@with_all_phases
+@spec_state_test
+def test_empty_epoch_transition_not_finalizing(spec, state):
+    # a whole epoch of empty slots: justification cannot advance, and
+    # every eligible validator loses balance at the boundary (no leak yet)
+    next_epoch(spec, state)  # move off genesis accounting
+    pre_finalized = state.finalized_checkpoint.epoch
+    yield 'pre', state
+    block = build_empty_block(
+        spec, state, state.slot + int(spec.SLOTS_PER_EPOCH) + 1
+    )
+    signed = state_transition_and_sign_block(spec, state, block)
+    assert state.finalized_checkpoint.epoch == pre_finalized
+    yield 'blocks', [signed]
+    yield 'post', state
+
+
+@with_all_phases
+@spec_state_test
+def test_deposit_top_up_exiting_validator(spec, state):
+    # a top-up deposit for a validator already past its exit epoch still
+    # credits the balance (deposits are unconditional balance credits)
+    index = 7
+    next_epoch(spec, state)
+    v = state.validators[index]
+    v.exit_epoch = spec.get_current_epoch(state)
+    v.withdrawable_epoch = v.exit_epoch + spec.config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY
+    amount = spec.EFFECTIVE_BALANCE_INCREMENT
+    # control: the same empty block WITHOUT the deposit (isolates the
+    # credit from per-block effects like altair's sync-committee penalty);
+    # copied BEFORE prepare so the expected-deposit-count gate stays zero
+    control = state.copy()
+    control_block = build_empty_block_for_next_slot(spec, control)
+    transition_unsigned_block(spec, control, control_block)
+    deposit = prepare_state_and_deposit(spec, state, index, amount, signed=True)
+    pre_balance = int(state.balances[index])
+    control_delta = int(control.balances[index]) - pre_balance
+    yield 'pre', state
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.deposits = [deposit]
+    signed = state_transition_and_sign_block(spec, state, block)
+    assert int(state.balances[index]) == pre_balance + control_delta + int(amount)
+    yield 'blocks', [signed]
+    yield 'post', state
+
+
+@with_all_phases
+@spec_state_test
+def test_previous_epoch_attestation_included_late(spec, state):
+    # an attestation from the previous epoch included at the edge of its
+    # inclusion window (SLOTS_PER_EPOCH after its slot) is still valid
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    from ...helpers.state import transition_to
+
+    att_slot = int(state.slot)
+    attestation = get_valid_attestation(spec, state, slot=att_slot, signed=True)
+    # the block lands exactly at the inclusion-window edge:
+    # block.slot == att_slot + SLOTS_PER_EPOCH
+    transition_to(spec, state, att_slot + int(spec.SLOTS_PER_EPOCH) - 1)
+    yield 'pre', state
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.attestations = [attestation]
+    signed = state_transition_and_sign_block(spec, state, block)
+    yield 'blocks', [signed]
+    yield 'post', state
