@@ -114,13 +114,22 @@ def run_generator(generator_name: str, providers, args=None) -> int:
                     skipped += 1
                     continue
                 meta = {}
+                wrote = 0
                 for (name, kind, value) in parts:
                     if kind == "meta":
                         meta[name] = _plainify(value)
                     else:
                         _write_part(case_dir, name, kind, value)
+                        wrote += 1
                 if meta:
                     (case_dir / "meta.yaml").write_text(_yaml_dump(meta))
+                if wrote == 0 and not meta:
+                    # unit-style test (asserts internally, yields no vector
+                    # parts): an empty case dir is meaningless to client
+                    # consumers — treat as filtered, not as a vector
+                    shutil.rmtree(case_dir)
+                    skipped += 1
+                    continue
             except Exception as e:
                 failed += 1
                 with error_log.open("a") as f:

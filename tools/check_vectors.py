@@ -1,0 +1,93 @@
+"""Vector-tree sanity checker: validate an emitted test-vector tree's
+layout and completeness (the consumer-side counterpart of gen_runner).
+
+Checks, per the reference's <preset>/<fork>/<runner>/<handler>/<suite>/<case>
+hierarchy (reference gen_helpers/gen_base/gen_runner.py:121-125):
+- every case directory sits at exactly depth 6 and contains at least one
+  part file (*.yaml / *.ssz_snappy);
+- no INCOMPLETE sentinels remain (crash containment: a sentinel means the
+  producing run died mid-case, gen_runner.py INCOMPLETE lifecycle);
+- ssz_snappy parts decompress with the repo's own codec.
+
+Usage: python tools/check_vectors.py VECTORS_DIR [--decode-sample N]
+Prints a per-runner case-count table and exits nonzero on any violation.
+"""
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("vectors_dir")
+    ap.add_argument("--decode-sample", type=int, default=25,
+                    help="ssz_snappy parts to decompress as a spot check")
+    args = ap.parse_args()
+    root = args.vectors_dir
+
+    incomplete = []
+    empty_cases = []
+    counts = {}  # (preset, fork, runner) -> cases
+    snappy_parts = []
+
+    for dirpath, dirnames, filenames in os.walk(root):
+        rel = os.path.relpath(dirpath, root)
+        parts = [] if rel == "." else rel.split(os.sep)
+        if "INCOMPLETE" in filenames or "INCOMPLETE" in dirnames:
+            incomplete.append(rel)
+        if len(parts) == 6:  # a case dir
+            data_files = [
+                f for f in filenames
+                if f.endswith((".yaml", ".ssz_snappy"))
+            ]
+            if not data_files:
+                empty_cases.append(rel)
+            key = tuple(parts[:3])
+            counts[key] = counts.get(key, 0) + 1
+            snappy_parts.extend(
+                os.path.join(dirpath, f) for f in filenames
+                if f.endswith(".ssz_snappy")
+            )
+
+    print(f"{'preset':<9} {'fork':<13} {'runner':<18} cases")
+    for (preset, fork, runner), n in sorted(counts.items()):
+        print(f"{preset:<9} {fork:<13} {runner:<18} {n}")
+    total = sum(counts.values())
+    print(f"total cases: {total}")
+
+    ok = True
+    if incomplete:
+        ok = False
+        print(f"FAIL: {len(incomplete)} INCOMPLETE sentinel(s), e.g. {incomplete[:3]}")
+    if empty_cases:
+        ok = False
+        print(f"FAIL: {len(empty_cases)} case dir(s) with no parts, e.g. {empty_cases[:3]}")
+    if total == 0:
+        ok = False
+        print("FAIL: no cases found")
+
+    if snappy_parts and args.decode_sample:
+        from consensus_specs_tpu.utils.snappy import decompress
+
+        sample = random.Random(7).sample(
+            snappy_parts, min(args.decode_sample, len(snappy_parts))
+        )
+        bad = 0
+        for path in sample:
+            try:
+                with open(path, "rb") as f:
+                    decompress(f.read())
+            except Exception as e:
+                bad += 1
+                print(f"FAIL: {path}: {type(e).__name__}: {e}")
+        print(f"ssz_snappy spot check: {len(sample) - bad}/{len(sample)} decode")
+        ok = ok and bad == 0
+
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()
