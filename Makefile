@@ -8,7 +8,7 @@ GENERATORS = operations sanity epoch_processing rewards finality forks transitio
              fork_choice ssz_static ssz_generic shuffling bls genesis merkle
 
 .PHONY: test citest test_tpu_backend lint generate_tests \
-        detect_generator_incomplete bench multichip clean_vectors \
+        detect_generator_incomplete check_vectors bench multichip clean_vectors \
         generate_random_tests
 
 # fast default: BLS stubbed except @always_bls, 4-way process-parallel
@@ -49,6 +49,10 @@ detect_generator_incomplete:
 	python -c "from consensus_specs_tpu.gen.gen_runner import detect_incomplete; \
 	import sys; bad = detect_incomplete('$(VECTORS_DIR)'); \
 	print('\n'.join(bad) or 'no incomplete cases'); sys.exit(1 if bad else 0)"
+
+# layout + completeness + snappy spot-check of an emitted vector tree
+check_vectors:
+	JAX_PLATFORMS=cpu python tools/check_vectors.py $(VECTORS_DIR)
 
 bench:
 	python bench.py
