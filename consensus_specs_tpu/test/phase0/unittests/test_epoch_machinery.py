@@ -33,3 +33,55 @@ def test_activation_epoch_respects_exit_lookahead(spec, state):
     current = spec.get_current_epoch(state)
     spec.process_registry_updates(state)
     assert state.validators[5].activation_epoch >= spec.compute_activation_exit_epoch(current)
+
+
+@with_all_phases
+@spec_state_test
+def test_churn_limit_floor_and_scaling(spec, state):
+    # the churn limit floors at MIN_PER_EPOCH_CHURN_LIMIT for small sets and
+    # scales as active_count // CHURN_LIMIT_QUOTIENT past the knee
+    active = len(spec.get_active_validator_indices(state, spec.get_current_epoch(state)))
+    limit = int(spec.get_validator_churn_limit(state))
+    expected = max(
+        int(spec.config.MIN_PER_EPOCH_CHURN_LIMIT),
+        active // int(spec.config.CHURN_LIMIT_QUOTIENT),
+    )
+    assert limit == expected
+    # the knee itself: exactly quotient*floor actives still yields the floor
+    knee = int(spec.config.MIN_PER_EPOCH_CHURN_LIMIT) * int(
+        spec.config.CHURN_LIMIT_QUOTIENT
+    )
+    assert (active < knee) == (limit == int(spec.config.MIN_PER_EPOCH_CHURN_LIMIT)) or (
+        active >= knee
+    )
+
+
+@with_all_phases
+@spec_state_test
+def test_effective_balance_caps_at_max(spec, state):
+    # a raw balance far above MAX_EFFECTIVE_BALANCE: the epoch update clamps
+    # the effective balance at the cap, never above
+    from ...helpers.epoch_processing import run_epoch_processing_to
+
+    index = 11
+    state.balances[index] = spec.Gwei(int(spec.MAX_EFFECTIVE_BALANCE) * 3)
+    run_epoch_processing_to(spec, state, "process_effective_balance_updates")
+    spec.process_effective_balance_updates(state)
+    assert state.validators[index].effective_balance == spec.MAX_EFFECTIVE_BALANCE
+
+
+@with_all_phases
+@spec_state_test
+def test_effective_balance_stable_inside_hysteresis_band(spec, state):
+    # a small wiggle (less than the downward/upward hysteresis margins)
+    # must NOT move the effective balance
+    from ...helpers.epoch_processing import run_epoch_processing_to
+
+    index = 12
+    increment = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    hysteresis = increment // int(spec.HYSTERESIS_QUOTIENT)
+    pre_effective = int(state.validators[index].effective_balance)
+    state.balances[index] = spec.Gwei(pre_effective + hysteresis)  # inside band
+    run_epoch_processing_to(spec, state, "process_effective_balance_updates")
+    spec.process_effective_balance_updates(state)
+    assert int(state.validators[index].effective_balance) == pre_effective
