@@ -140,3 +140,47 @@ def test_filtered_block_tree(spec, state):
 
     assert spec.get_head(store) == head
     yield 'steps', 'data', test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_vote_moves_head_to_lighter_fork(spec, state):
+    # two competing single-block forks with a no-vote tie: one attestation
+    # for the tie-LOSING side must flip the head (LMD weight beats the
+    # lexicographic tie-break, fork-choice.md get_latest_attesting_balance)
+    test_steps = []
+    store, genesis_block = get_genesis_forkchoice_store_and_block(spec, state)
+
+    state_a = state.copy()
+    state_b = state.copy()
+    block_a = build_empty_block_for_next_slot(spec, state_a)
+    block_a.body.graffiti = spec.Bytes32(b"\x01" * 32)
+    signed_a = state_transition_and_sign_block(spec, state_a, block_a)
+    block_b = build_empty_block_for_next_slot(spec, state_b)
+    block_b.body.graffiti = spec.Bytes32(b"\x02" * 32)
+    signed_b = state_transition_and_sign_block(spec, state_b, block_b)
+
+    yield 'anchor_state', get_anchor_parts(spec, state)[0]
+    yield 'anchor_block', get_anchor_parts(spec, state)[1]
+    tick_and_add_block(spec, store, signed_a, test_steps)
+    tick_and_add_block(spec, store, signed_b, test_steps)
+
+    root_a = spec.hash_tree_root(block_a)
+    root_b = spec.hash_tree_root(block_b)
+    tie_head = spec.get_head(store)
+    assert tie_head in (root_a, root_b)
+    loser_state, loser_signed, loser_root = (
+        (state_a, signed_a, root_a) if tie_head == root_b
+        else (state_b, signed_b, root_b)
+    )
+
+    # one vote for the tie loser: head must flip to it
+    attestation = get_valid_attestation(
+        spec, loser_state, slot=loser_signed.message.slot, signed=True,
+        beacon_block_root=loser_root,
+    )
+    # advance the store clock so the attestation's slot+1 is reached
+    tick_to_slot(spec, store, loser_signed.message.slot + 1, test_steps)
+    add_attestation(spec, store, attestation, test_steps)
+    assert spec.get_head(store) == loser_root
+    yield 'steps', 'data', test_steps
