@@ -7,6 +7,13 @@ PYTEST = JAX_PLATFORMS=cpu python -m pytest
 GENERATORS = operations sanity epoch_processing rewards finality forks transition random \
              fork_choice ssz_static ssz_generic shuffling bls genesis merkle
 
+# sweep split: state-machine-heavy runners emit minimal-preset only (the
+# reference's CI posture); cheap runners emit every preset they define —
+# shuffling/bls/ssz_generic/genesis/merkle cover mainnet/general too
+HEAVY_GENERATORS = operations sanity epoch_processing rewards finality forks transition \
+                   random fork_choice ssz_static
+CHEAP_GENERATORS = shuffling bls ssz_generic genesis merkle
+
 .PHONY: test citest test_tpu_backend lint generate_tests \
         detect_generator_incomplete check_vectors bench multichip clean_vectors \
         generate_random_tests
@@ -39,6 +46,22 @@ generate_tests:
 		JAX_PLATFORMS=cpu python -m consensus_specs_tpu.gen.generators.$$g \
 			-o $(VECTORS_DIR) || exit 1; \
 	done
+
+# full reproducible sweep + committed evidence: regenerate the tree
+# (minimal preset for the heavy state runners, all presets for the cheap
+# ones) and write the validated case-count report the repo commits
+# (VECTORS_REPORT.md) — `make sweep` is what CI runs and what re-checks
+# the round-4 finding that sweep evidence must persist in-repo
+sweep:
+	@for g in $(HEAVY_GENERATORS); do \
+		JAX_PLATFORMS=cpu python -m consensus_specs_tpu.gen.generators.$$g \
+			-o $(VECTORS_DIR) -l minimal || exit 1; \
+	done
+	@for g in $(CHEAP_GENERATORS); do \
+		JAX_PLATFORMS=cpu python -m consensus_specs_tpu.gen.generators.$$g \
+			-o $(VECTORS_DIR) || exit 1; \
+	done
+	JAX_PLATFORMS=cpu python tools/check_vectors.py $(VECTORS_DIR) --report VECTORS_REPORT.md
 
 # regenerate the code-generated random scenario-matrix test modules
 # (reference `make -C tests/generators/random`)

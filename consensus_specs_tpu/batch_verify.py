@@ -190,11 +190,13 @@ class SignatureCollector:
 
     # -- batched resolution -------------------------------------------------
 
-    def flush(self, backend=None) -> np.ndarray:
+    def flush(self, backend=None, mesh=None) -> np.ndarray:
         """Verify all recorded checks; returns a bool array in record order.
 
         Checks are grouped by (kind, K-bucket) so each device batch pads to
-        its own committee-size bucket (ops/bls_backend.py _K_BUCKETS)."""
+        its own committee-size bucket (ops/bls_backend.py _K_BUCKETS).
+        With ``mesh``, each bucket's batch axis is sharded over the mesh
+        (SURVEY §2.7/P1 — the committee axis is the DP axis)."""
         if backend is None:
             from .ops import bls_backend as backend  # noqa: F811
 
@@ -210,12 +212,14 @@ class SignatureCollector:
                     [self.checks[i].pubkeys for i in idxs],
                     [self.checks[i].messages for i in idxs],
                     [self.checks[i].signature for i in idxs],
+                    mesh=mesh,
                 )
             else:
                 res = backend.batch_aggregate_verify(
                     [self.checks[i].pubkeys for i in idxs],
                     [self.checks[i].messages for i in idxs],
                     [self.checks[i].signature for i in idxs],
+                    mesh=mesh,
                 )
             for j, i in enumerate(idxs):
                 out[i] = bool(res[j])

@@ -25,6 +25,8 @@ import os
 import pickle
 import time
 
+import numpy as np
+
 from ..batch_verify import SignatureCollector
 from ..utils import bls
 from ..utils.bls12_381 import R
@@ -120,13 +122,41 @@ def _seed_host_caches(col, slots, committees, k_att, k_sync, pool_size):
             msgs.update(bytes(m) for m in c.messages)
         sigs.add(bytes(c.signature))
         pks.update(bytes(p) for p in c.pubkeys)
+    # the limb layout is an implementation detail of the ops package — a
+    # stale file from a different limb width/count or backend revision
+    # would silently seed wrong encodings into live verification caches,
+    # so the fingerprint is part of the NAME (like .vm_cache entries)
+    import hashlib
+
+    from ..ops import fq
+
+    with open(fq.__file__, "rb") as fh:
+        fq_fp = hashlib.sha256(fh.read()).hexdigest()[:10]
+    # fq alone defines the limb encoding — keying on the full builder
+    # fingerprint would invalidate this 100+ s rebuild on every VM edit
+    tag = f"_limbs_{fq.LIMB_BITS}x{fq.NUM_LIMBS}_{fq_fp}.pkl"
     path = _cache_path(slots, committees, k_att, k_sync, pool_size).replace(
-        ".pkl", "_limbs.pkl"
+        ".pkl", tag
     )
     try:
         with open(path, "rb") as f:
             m, s, p = pickle.load(f)
         if msgs <= set(m) and sigs <= set(s) and pks <= set(p):
+            # spot-verify one entry of EACH cache against a fresh
+            # recompute before trusting the file: the fq fingerprint in
+            # the name can't see layout changes in bls_backend's sig/pk
+            # encoders, and the key-superset check can't see values
+            for loaded, live, compute in (
+                (m, msgs, B._message_limbs_compute),
+                (s, sigs, B._signature_limbs_compute),
+                (p, pks, B._pubkey_limbs_compute),
+            ):
+                probe = next(iter(live))
+                fresh = compute(probe)
+                if isinstance(fresh, ValueError) or not np.array_equal(
+                    np.asarray(loaded[probe]), np.asarray(fresh)
+                ):
+                    raise ValueError("limb cache spot-check mismatch")
             B._MSG_CACHE.update(m)
             B._SIG_CACHE.update(s)
             B._PK_CACHE.update(p)

@@ -246,8 +246,17 @@ def _cached(cache: Dict, key: bytes, compute):
         v = compute(key)
         if not isinstance(v, ValueError):
             if len(cache) >= _CACHE_CAPS[id(cache)]:
-                cache.clear()  # rare: that many DISTINCT valid inputs
+                # rare: that many DISTINCT valid inputs. Evict the
+                # least-recently-USED half (hits below refresh insertion
+                # order, so dict order IS recency order) — wiping the
+                # whole pubkey cache would drop every hot validator key
+                # at once and cause a multi-second recompute cliff
+                for k in list(cache.keys())[: len(cache) // 2]:
+                    del cache[k]
             cache[key] = v
+    else:
+        # refresh recency so prewarmed hot keys outlive per-epoch churn
+        cache[key] = cache.pop(key)
     if isinstance(v, ValueError):
         raise v
     return v
@@ -311,6 +320,9 @@ def _prewarm_worker(args):
         return kind, payload, None
 
 
+_POOL_BROKEN = False
+
+
 def prewarm_host_caches(messages: Sequence[bytes], signatures: Sequence[bytes],
                         pubkeys: Sequence[bytes] = ()):
     """Fill the hash-to-G2, signature-decode, and pubkey caches with a
@@ -333,13 +345,19 @@ def prewarm_host_caches(messages: Sequence[bytes], signatures: Sequence[bytes],
     )
     if procs <= 1:
         return
+    global _POOL_BROKEN
+    if _POOL_BROKEN:
+        return  # a pool already hung/died this process: go straight serial
     try:
         import multiprocessing as mp
 
         # 'fork' after jax initialization carries a documented deadlock
         # hazard (children inherit runtime locks); the workers are pure
         # Python, but guard with a deadline anyway — a hung pool must
-        # degrade to the serial path, not block verification forever
+        # degrade to the serial path, not block verification forever.
+        # ('spawn' is NOT a safe default here: children re-import the
+        # package, which re-registers the axon PJRT plugin and can hang
+        # at backend init — TPU_NOTES.md failure mode 1.)
         ctx = mp.get_context(os.environ.get("CONSENSUS_SPECS_TPU_HASH_MP_CTX",
                                             "fork"))
         deadline = max(120.0, 0.2 * len(work))
@@ -355,7 +373,10 @@ def prewarm_host_caches(messages: Sequence[bytes], signatures: Sequence[bytes],
                 ):
                     cache[payload] = value
     except Exception:
-        pass  # serial fallback: the item loop computes on demand
+        # serial fallback: the item loop computes on demand. Latch the
+        # failure — without this, every subsequent batch would re-pay the
+        # full pool deadline (>=120 s) before degrading, each time.
+        _POOL_BROKEN = True
 
 
 def _flat_ints_to_oracle(coeffs: Sequence[int]) -> O.Fq12:

@@ -108,22 +108,30 @@ def _shifted(vec, offset, total):
 def mont_mul(a, b):
     """Montgomery product a*b*R^-1 (mod p); loose in, loose out.
 
+    With CONSENSUS_SPECS_TPU_PALLAS=1 the multiply dispatches to the
+    hand-tiled pure-uint32 Pallas kernel (ops/pallas_fq.py) — same
+    Montgomery domain (R = 2^420), bit-identical results, all work in
+    VMEM; otherwise the jnp uint64 lowering (mont_mul_u64) runs."""
+    from . import pallas_fq
+
+    if pallas_fq.enabled():
+        return pallas_fq.mont_mul(a, b)
+    return mont_mul_u64(a, b)
+
+
+def mont_mul_u64(a, b):
+    """The jnp uint64 lowering of mont_mul, reachable directly so the
+    Pallas A/B (bench/pallas_ab.py) can baseline against it even when the
+    Pallas dispatch is switched on.
+
     Vectorized SOS: the schoolbook product and each reduction step are
     whole-vector ops (broadcast multiply + statically-padded shift + add) so
     a call site is ~100 HLO ops — no scatters, XLA-compile-friendly.
-
-    With CONSENSUS_SPECS_TPU_PALLAS=1 the multiply dispatches to the
-    hand-tiled pure-uint32 Pallas kernel (ops/pallas_fq.py) — same
-    Montgomery domain (R = 2^420), bit-identical results, all work in VMEM.
 
     Overflow audit (uint64 columns): schoolbook columns accumulate <= 15
     products of loose limbs (< 2^28 each) => < 15*2^56 < 2^60; the reduction
     adds one m*P_limb (< 2^56) per outer step per column plus single-limb
     carries => total < 2^62."""
-    from . import pallas_fq
-
-    if pallas_fq.enabled():
-        return pallas_fq.mont_mul(a, b)
     a = jnp.asarray(a, jnp.uint64)
     b = jnp.asarray(b, jnp.uint64)
     n0 = jnp.uint64(N0)

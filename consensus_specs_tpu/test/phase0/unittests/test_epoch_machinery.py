@@ -47,13 +47,12 @@ def test_churn_limit_floor_and_scaling(spec, state):
         active // int(spec.config.CHURN_LIMIT_QUOTIENT),
     )
     assert limit == expected
-    # the knee itself: exactly quotient*floor actives still yields the floor
-    knee = int(spec.config.MIN_PER_EPOCH_CHURN_LIMIT) * int(
-        spec.config.CHURN_LIMIT_QUOTIENT
-    )
-    assert (active < knee) == (limit == int(spec.config.MIN_PER_EPOCH_CHURN_LIMIT)) or (
-        active >= knee
-    )
+    # the knee: the limit sits at the floor exactly while
+    # active // quotient <= floor, i.e. active < (floor + 1) * quotient —
+    # a biconditional, so neither side can pass vacuously
+    floor = int(spec.config.MIN_PER_EPOCH_CHURN_LIMIT)
+    quotient = int(spec.config.CHURN_LIMIT_QUOTIENT)
+    assert (limit == floor) == (active < (floor + 1) * quotient)
 
 
 @with_all_phases

@@ -1,0 +1,125 @@
+"""Unit tests for bench.py's parent/child harness logic.
+
+The accelerator child emits committee-stage lines, epoch-stage lines, a
+pallas_ab probe line, and error lines, all interleaved; `_best_line` is
+the parent's only view of a killed window, so its selection rules are
+what decide whether a granted window becomes a recorded number
+(TPU_NOTES.md; round-4 verdict item 1). These tests pin those rules
+without needing any device.
+"""
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+_BENCH = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "bench.py")
+
+
+@pytest.fixture(scope="module")
+def bench():
+    spec = importlib.util.spec_from_file_location("bench_under_test", _BENCH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _lines(*objs):
+    return ("\n".join(json.dumps(o) for o in objs)).encode()
+
+
+def test_best_line_picks_max_value(bench):
+    best, err = bench._best_line(_lines(
+        {"value": 100.0, "mode": "committee", "stage": "rep 1/3"},
+        {"value": 300.0, "mode": "committee"},
+        {"value": 250.0, "mode": "epoch", "stage": "warmup (compile-inclusive)"},
+    ))
+    assert err is None
+    assert best["value"] == 300.0
+    # both modes landed: each mode's best is attached for the record
+    assert best["per_mode_best"] == {"committee": 300.0, "epoch": 250.0}
+
+
+def test_best_line_single_mode_has_no_per_mode_key(bench):
+    best, _ = bench._best_line(_lines({"value": 42.0, "mode": "committee"}))
+    assert best["value"] == 42.0
+    assert "per_mode_best" not in best
+
+
+def test_best_line_attaches_probe_and_surfaces_error(bench):
+    best, err = bench._best_line(_lines(
+        {"value": 500.0, "mode": "committee"},
+        {"value": 0.0, "error": "epoch stage RuntimeError: device lost"},
+        {"probe": "pallas_ab", "pallas_over_u64": 2.5, "pallas_chain_match": True},
+    ))
+    # a later stage's failure must not discard the landed committee number
+    assert best["value"] == 500.0
+    assert best["pallas_ab"]["pallas_over_u64"] == 2.5
+    assert "probe" not in best["pallas_ab"]
+    assert "device lost" in err
+
+
+def test_best_line_none_on_errors_only(bench):
+    best, err = bench._best_line(_lines({"value": 0.0, "error": "backend init hang"}))
+    assert best is None
+    assert err == "backend init hang"
+
+
+def test_best_line_ignores_garbage(bench):
+    raw = b"WARNING: noise\n" + _lines({"value": 7.0, "mode": "committee"}) + b"\nnot json"
+    best, err = bench._best_line(raw)
+    assert best["value"] == 7.0 and err is None
+
+
+def test_child_runs_committee_then_epoch_then_probe(bench, monkeypatch, capsys):
+    """The child must run the window-proven committee shape FIRST, then
+    epoch, then the pallas A/B — one process, every stage surviving the
+    previous one's failure (round-4 verdict: a grant must never be
+    gambled on epoch mode alone)."""
+    calls = []
+
+    def fake_run_workload(emit_partial=None, override=None, child_quick=False):
+        calls.append(override)
+        if override[3] == "epoch":
+            raise RuntimeError("window died mid-epoch")
+        return {"value": 123.0, "vs_baseline": 0.1, "mode": override[3]}
+
+    class FakeJax:
+        @staticmethod
+        def default_backend():
+            return "tpu"
+
+    monkeypatch.setattr(bench, "run_workload", fake_run_workload)
+    monkeypatch.setitem(sys.modules, "jax", FakeJax())
+    monkeypatch.setenv(bench._CHILD_FLAG, "1")
+    for v in ("BENCH_N", "BENCH_K", "BENCH_REPS", "BENCH_MODE"):
+        monkeypatch.delenv(v, raising=False)
+
+    bench.main()
+    out = [json.loads(l) for l in capsys.readouterr().out.strip().splitlines()]
+
+    assert calls[0] == (32, 128, 3, "committee")
+    assert calls[1][3] == "epoch"
+    assert out[0]["value"] == 123.0 and out[0]["mode"] == "committee"
+    assert any("epoch stage RuntimeError" in o.get("error", "") for o in out)
+    # probe stage still ran after the epoch failure (probe_error is fine
+    # here: the fake jax can't run a real kernel)
+    assert out[-1].get("probe") == "pallas_ab"
+
+
+def test_child_env_override_collapses_to_single_stage(bench, monkeypatch, capsys):
+    calls = []
+
+    def fake_run_workload(emit_partial=None, override=None, child_quick=False):
+        calls.append((override, child_quick))
+        return {"value": 9.0, "vs_baseline": 0.01, "mode": "epoch"}
+
+    monkeypatch.setattr(bench, "run_workload", fake_run_workload)
+    monkeypatch.setenv(bench._CHILD_FLAG, "1")
+    monkeypatch.setenv("BENCH_MODE", "epoch")
+
+    bench.main()
+    out = [json.loads(l) for l in capsys.readouterr().out.strip().splitlines()]
+    assert calls == [(None, True)]
+    assert out[-1]["value"] == 9.0

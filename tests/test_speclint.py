@@ -1,0 +1,123 @@
+"""Unit tests for the speclint call-signature pass (tools/speclint.py).
+
+The pass is the repo's slice of the reference's strict-mypy gate
+(reference Makefile:133-136, linter.ini): a fork override that changes a
+helper's parameters must fail `make lint` at every stale call site.
+These tests seed exactly that class of bug into a synthetic namespace
+and check the pass reports it — and stays silent on the legal shapes it
+must not flag (splats, shadowing, defaults, keywords).
+"""
+import importlib.util
+import os
+import textwrap
+
+import pytest
+
+_SPECLINT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools", "speclint.py"
+)
+
+
+@pytest.fixture(scope="module")
+def speclint():
+    spec = importlib.util.spec_from_file_location("speclint_under_test", _SPECLINT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _build_ns(src, tmp_path, name="seeded_spec"):
+    """Exec ``src`` the way the builder does — compiled against a real
+    file so inspect.getsource works — and return the namespace dict."""
+    path = tmp_path / f"{name}.py"
+    path.write_text(textwrap.dedent(src))
+    ns = {}
+    code = compile(path.read_text(), str(path), "exec")
+    exec(code, ns)
+    # mimic module globals: functions defined by the exec see ns as their
+    # __globals__, which is what check_call_signatures keys on
+    return ns
+
+
+def test_wrong_arity_is_caught(speclint, tmp_path):
+    ns = _build_ns(
+        """
+        def helper(state, index):
+            return index
+
+        def process_thing(state):
+            return helper(state)  # stale call site: missing 'index'
+        """,
+        tmp_path,
+    )
+    findings = speclint.check_call_signatures(ns, "<seeded>")
+    assert len(findings) == 1
+    assert "process_thing" in findings[0] and "helper()" in findings[0]
+
+
+def test_unknown_keyword_is_caught(speclint, tmp_path):
+    ns = _build_ns(
+        """
+        def helper(state, index=0):
+            return index
+
+        def process_thing(state):
+            return helper(state, idx=3)  # typo'd keyword
+        """,
+        tmp_path,
+    )
+    findings = speclint.check_call_signatures(ns, "<seeded>")
+    assert len(findings) == 1 and "does not bind" in findings[0]
+
+
+def test_too_many_positionals_is_caught(speclint, tmp_path):
+    ns = _build_ns(
+        """
+        def helper(state):
+            return state
+
+        def process_thing(state):
+            return helper(state, 1, 2)
+        """,
+        tmp_path,
+    )
+    assert len(speclint.check_call_signatures(ns, "<seeded>")) == 1
+
+
+def test_legal_shapes_stay_silent(speclint, tmp_path):
+    ns = _build_ns(
+        """
+        def helper(state, index=0, *, flag=False):
+            return index
+
+        def uses_default(state):
+            return helper(state)
+
+        def uses_keyword(state):
+            return helper(state, index=2, flag=True)
+
+        def uses_splat(state, args):
+            return helper(*args)  # unknowable statically: skipped
+
+        def shadows(state):
+            helper = len  # local shadow: the ns function is NOT the callee
+            return helper(state)
+        """,
+        tmp_path,
+    )
+    assert speclint.check_call_signatures(ns, "<seeded>") == []
+
+
+def test_non_function_callees_are_skipped(speclint, tmp_path):
+    ns = _build_ns(
+        """
+        class Thing:
+            def __init__(self, a, b):
+                pass
+
+        def make(state):
+            return Thing(1, 2, 3)  # classes use a different convention: skipped
+        """,
+        tmp_path,
+    )
+    assert speclint.check_call_signatures(ns, "<seeded>") == []

@@ -10,12 +10,17 @@ hierarchy (reference gen_helpers/gen_base/gen_runner.py:121-125):
 - ssz_snappy parts decompress with the repo's own codec.
 
 Usage: python tools/check_vectors.py VECTORS_DIR [--decode-sample N]
+                                     [--report PATH]
 Prints a per-runner case-count table and exits nonzero on any violation.
+``--report`` additionally writes the table + verdict as a markdown file —
+the committed, reproducible evidence of a sweep (`make sweep` regenerates
+tree and report; round-4 verdict: vector evidence must persist in-repo).
 """
 import argparse
 import os
 import random
 import sys
+import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -25,6 +30,8 @@ def main():
     ap.add_argument("vectors_dir")
     ap.add_argument("--decode-sample", type=int, default=25,
                     help="ssz_snappy parts to decompress as a spot check")
+    ap.add_argument("--report", default=None,
+                    help="also write the table + verdict as markdown here")
     args = ap.parse_args()
     root = args.vectors_dir
 
@@ -85,6 +92,33 @@ def main():
                 print(f"FAIL: {path}: {type(e).__name__}: {e}")
         print(f"ssz_snappy spot check: {len(sample) - bad}/{len(sample)} decode")
         ok = ok and bad == 0
+
+    if args.report:
+        lines = [
+            "# Vector sweep report",
+            "",
+            f"Generated {time.strftime('%Y-%m-%d %H:%M UTC', time.gmtime())} "
+            f"by `tools/check_vectors.py {root}` (regenerate: `make sweep`).",
+            "",
+            "| preset | fork | runner | cases |",
+            "|---|---|---|---|",
+        ]
+        lines += [
+            f"| {p} | {f} | {r} | {n} |"
+            for (p, f, r), n in sorted(counts.items())
+        ]
+        lines += [
+            "",
+            f"- total cases: **{total}**",
+            f"- INCOMPLETE sentinels: {len(incomplete)}",
+            f"- empty case dirs: {len(empty_cases)}",
+            f"- ssz_snappy parts: {len(snappy_parts)}",
+            f"- verdict: **{'PASS' if ok else 'FAIL'}**",
+            "",
+        ]
+        with open(args.report, "w") as f:
+            f.write("\n".join(lines))
+        print(f"report written: {args.report}")
 
     sys.exit(0 if ok else 1)
 

@@ -17,7 +17,13 @@ ships neither tool (no installs allowed). Two layers:
      code objects) must resolve in the built module or builtins: catches
      fork layering dropping a dependency;
    - every function annotation must resolve (typing.get_type_hints);
-   - every SSZ container field type must be a real View class.
+   - every SSZ container field type must be a real View class;
+   - every direct call from a spec function to another function in the
+     built namespace must BIND against the callee's signature (arity +
+     keyword validity, inspect.signature.bind) — the cheapest meaningful
+     slice of the reference's strict-mypy gate: a fork override that
+     changes a helper's parameters breaks every stale call site at lint
+     time, not at test-coverage mercy.
 
 Exit status 0 = clean. Any finding prints `path:line: message` and fails.
 """
@@ -172,6 +178,53 @@ def _function_names(fn):
     return out
 
 
+def check_call_signatures(ns: dict, where: str):
+    """For every function whose home namespace is ``ns``, parse its source
+    and check each direct ``name(...)`` call whose callee resolves to a
+    plain Python function in ``ns``: the written-out arguments must bind
+    against the callee's signature. Call sites using *args/**kwargs, and
+    callees that aren't plain functions (classes, builtins, SSZ types —
+    different calling conventions), are skipped."""
+    import inspect
+    import textwrap
+
+    findings = []
+    for name in sorted(ns):
+        fn = ns[name]
+        if not (callable(fn) and hasattr(fn, "__code__")):
+            continue
+        if getattr(fn, "__globals__", None) is not ns:
+            continue  # imported helper: its own module's lint covers it
+        try:
+            tree = ast.parse(textwrap.dedent(inspect.getsource(fn)))
+        except (OSError, SyntaxError, TypeError):
+            continue  # source not recoverable (exec'd without a file)
+        local_names = set(fn.__code__.co_varnames)
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)):
+                continue
+            if node.func.id in local_names:
+                continue  # shadowed by a local: not the ns function
+            callee = ns.get(node.func.id)
+            if not inspect.isfunction(callee):
+                continue
+            if any(isinstance(a, ast.Starred) for a in node.args) or any(
+                kw.arg is None for kw in node.keywords
+            ):
+                continue  # splatted call: arity unknowable statically
+            try:
+                inspect.signature(callee).bind(
+                    *[None] * len(node.args),
+                    **{kw.arg: None for kw in node.keywords},
+                )
+            except TypeError as e:
+                findings.append(
+                    f"{where}: {name} line {node.lineno}: call to "
+                    f"{node.func.id}() does not bind: {e}"
+                )
+    return findings
+
+
 def check_built_spec(fork: str, preset: str):
     import typing
 
@@ -205,6 +258,7 @@ def check_built_spec(fork: str, preset: str):
                     findings.append(
                         f"{where}: container {name}.{fname} has non-View type {ftyp!r}"
                     )
+    findings += check_call_signatures(ns, where)
     return findings
 
 

@@ -1,0 +1,21 @@
+#!/bin/bash
+# Round-5 persistent TPU harvest loop. The bench child is now self-
+# sufficient (bench.py): ONE process runs committee mode at the window-
+# proven shape first, then the epoch workload with per-rep emission, then
+# the Pallas-vs-u64 A/B — so a single tunnel grant answers everything and
+# no second process launch is needed (grants evaporate between launches,
+# TPU_NOTES.md round-4 entry). This loop just retries that child with a
+# generous deadline and logs every line it flushes.
+#
+# Usage: tools/tpu_harvest_r5.sh [out.jsonl] — loops until killed.
+OUT=${1:-/tmp/tpu_harvest_r5.jsonl}
+cd "$(dirname "$0")/.." || exit 1
+i=0
+while true; do
+  i=$((i + 1))
+  echo "=== attempt $i $(date -u +%H:%M:%S) ===" >> "$OUT"
+  CONSENSUS_SPECS_TPU_BENCH_CHILD=1 \
+    timeout 1800 python bench.py >> "$OUT" 2>/dev/null
+  echo "=== attempt $i end rc=$? $(date -u +%H:%M:%S) ===" >> "$OUT"
+  sleep 10
+done

@@ -73,7 +73,10 @@ try:
         a = np.stack([mod.to_mont_int(x) for x in xs])
         b = np.stack([mod.to_mont_int((x * 7 + 3) % mod.P) for x in xs])
         da, db = jax.device_put(a), jax.device_put(b)
-        f = jax.jit(lambda u, v: mod.mont_mul(u, v))
+        # baseline the raw representation, not fq.mont_mul's dispatcher —
+        # under CONSENSUS_SPECS_TPU_PALLAS=1 the latter IS the Pallas kernel
+        mm = getattr(mod, 'mont_mul_u64', mod.mont_mul)
+        f = jax.jit(lambda u, v: mm(u, v))
         t0 = time.time(); f(da, db).block_until_ready()
         compile_s = time.time() - t0
         t0 = time.time()
