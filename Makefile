@@ -89,3 +89,7 @@ clean_vectors:
 # build the native batched-SHA256 merkleization kernel (csrc/)
 native:
 	gcc -O3 -fPIC -shared -o csrc/libsha256_batch.so csrc/sha256_batch.c
+
+# regenerate the human-readable per-fork spec document set from specsrc/
+docs:
+	python tools/render_spec.py

@@ -178,6 +178,46 @@ def run_workload(emit_partial=None, override=None, child_quick=False) -> dict:
     return final
 
 
+def _init_backend_with_watchdog(exit_fn=None) -> bool:
+    """Initialize the JAX backend under a deadline (BENCH_INIT_DEADLINE,
+    default 150 s) and return True when it resolved to plain CPU.
+
+    The axon tunnel's dominant failure mode is a backend-init block that
+    lasts 9-25+ minutes before hanging or erroring (TPU_NOTES.md), while
+    every observed GRANT initialized within seconds — so waiting out a
+    slow init only burns the harvest loop's sampling rate (and, under the
+    driver's 420 s child deadline, the CPU-fallback budget). A daemon
+    watchdog flushes a parseable error line and hard-exits the child if
+    init overruns; a live grant proceeds in THIS process untouched."""
+    import threading
+
+    deadline = float(os.environ.get("BENCH_INIT_DEADLINE", "150"))
+    if exit_fn is None:
+        exit_fn = os._exit
+    done = threading.Event()
+
+    def watchdog():
+        if not done.wait(deadline):
+            _emit(
+                0.0,
+                0.0,
+                error=(
+                    f"backend init exceeded {deadline:.0f}s "
+                    "(tunnel hang; grants initialize in seconds)"
+                ),
+            )
+            sys.stdout.flush()
+            exit_fn(3)
+
+    threading.Thread(target=watchdog, daemon=True).start()
+    try:
+        import jax
+
+        return jax.default_backend() == "cpu"
+    finally:
+        done.set()
+
+
 def _best_line(stdout_bytes: bytes):
     """Best-throughput success JSON line in the child's output, or
     (None, last-error-string). The child emits two stages (committee then
@@ -187,7 +227,7 @@ def _best_line(stdout_bytes: bytes):
     committee number AND the epoch number, not just the winner."""
     err = None
     best = None
-    probe = None
+    probes = {}
     mode_best = {}
     for line in stdout_bytes.decode(errors="replace").strip().splitlines():
         try:
@@ -195,7 +235,9 @@ def _best_line(stdout_bytes: bytes):
         except ValueError:
             continue
         if "probe" in parsed:
-            probe = parsed
+            probes[parsed["probe"]] = {
+                k: v for k, v in parsed.items() if k != "probe"
+            }
         elif "error" in parsed:
             err = parsed["error"]
         elif parsed.get("value", 0) > 0:
@@ -208,8 +250,8 @@ def _best_line(stdout_bytes: bytes):
         best = dict(best)
         if len(mode_best) > 1:
             best["per_mode_best"] = {m: round(v, 2) for m, v in mode_best.items()}
-        if probe is not None:
-            best["pallas_ab"] = {k: v for k, v in probe.items() if k != "probe"}
+        if probes:
+            best["probes"] = probes
     return best, err
 
 
@@ -273,9 +315,7 @@ def main():
                 _emit(0.0, 0.0, error=f"{type(e).__name__}: {e}")
             return
         try:
-            import jax
-
-            on_plain_cpu = jax.default_backend() == "cpu"
+            on_plain_cpu = _init_backend_with_watchdog()
         except Exception as e:
             _emit(0.0, 0.0, error=f"backend init {type(e).__name__}: {e}")
             return
@@ -301,21 +341,35 @@ def main():
                     0.0,
                     error=f"{stage_override[3]} stage {type(e).__name__}: {e}",
                 )
-        # stage 3: the Pallas-vs-u64 kernel A/B (SURVEY §7.3 risks #1-#2)
-        # in the SAME process — the grant that landed the numbers above
-        # also answers the kernel-dispatch question. Failure is reported
-        # as probe_error, never as a workload error.
-        try:
-            from consensus_specs_tpu.bench.pallas_ab import run_pallas_ab
+        # stage 3: the Pallas kernel A/Bs (SURVEY §7.3 risks #1-#2) in the
+        # SAME process — the grant that landed the numbers above also
+        # answers the kernel-dispatch questions: raw mont_mul vs the u64
+        # lowering, then the whole-VM-program race across all three
+        # dispatch modes. Failures are probe_error lines, never workload
+        # errors.
+        for probe_name, fn_name in (
+            ("pallas_ab", "run_pallas_ab"),
+            ("vm_step_ab", "run_step_ab"),
+        ):
+            try:
+                # import inside the guard: an import-time failure must
+                # also become a probe_error line, never a child crash
+                from consensus_specs_tpu.bench import pallas_ab
 
-            print(json.dumps({"probe": "pallas_ab", **run_pallas_ab()}), flush=True)
-        except Exception as e:
-            print(
-                json.dumps(
-                    {"probe": "pallas_ab", "probe_error": f"{type(e).__name__}: {e}"[:300]}
-                ),
-                flush=True,
-            )
+                probe_fn = getattr(pallas_ab, fn_name)
+                print(
+                    json.dumps({"probe": probe_name, **probe_fn()}), flush=True
+                )
+            except Exception as e:
+                print(
+                    json.dumps(
+                        {
+                            "probe": probe_name,
+                            "probe_error": f"{type(e).__name__}: {e}"[:300],
+                        }
+                    ),
+                    flush=True,
+                )
         return
 
     # Attempt the configured/default platform in a deadline-guarded child

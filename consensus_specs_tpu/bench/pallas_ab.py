@@ -65,3 +65,73 @@ def run_pallas_ab(batch: int = 4096, iters: int = 32) -> dict:
         "pallas_chain_match": bool(pl_match),
         "pallas_over_u64": round(pl_rate / u64_rate, 3),
     }
+
+
+def run_step_ab(batch: int = 128, reps: int = 3) -> dict:
+    """Whole-VM-program A/B across the three dispatch modes — '0' (u64
+    scan), '1' (mont_mul-only Pallas), 'step' (fused mul+lin kernel on the
+    14-bit register file, ops/pallas_step.py) — on one real assembled
+    pairing program. This is the measurement that decides the production
+    CONSENSUS_SPECS_TPU_PALLAS default. A mode's speedup ratio is emitted
+    ONLY if its outputs matched mode '0' bit-for-bit; a mismatching mode
+    reports its raw timings and match=False, never a headline ratio."""
+    import os
+    import time
+
+    import numpy as np
+
+    from __graft_entry__ import _example_program_and_inputs
+    from ..ops import vm
+
+    prog, regs, _ = _example_program_and_inputs(batch=batch)
+    ins = {
+        name: np.asarray(regs[..., int(r), :])
+        for name, r in zip(prog.input_names, prog.input_regs)
+    }
+
+    def run_mode(value):
+        old = os.environ.get("CONSENSUS_SPECS_TPU_PALLAS")
+        os.environ["CONSENSUS_SPECS_TPU_PALLAS"] = value
+        try:
+            t0 = time.time()
+            out = vm.execute(prog, ins, batch_shape=(batch,))
+            compile_s = time.time() - t0
+            t0 = time.time()
+            for _ in range(reps):
+                out = vm.execute(prog, ins, batch_shape=(batch,))
+            run_s = (time.time() - t0) / reps
+        finally:
+            if old is None:
+                os.environ.pop("CONSENSUS_SPECS_TPU_PALLAS", None)
+            else:
+                os.environ["CONSENSUS_SPECS_TPU_PALLAS"] = old
+        return out, compile_s, run_s
+
+    import jax
+
+    result = {
+        "platform": jax.default_backend(),
+        "batch": batch,
+        "n_steps": prog.n_steps,
+    }
+    baseline = None
+    rates = {}
+    matched = {}
+    for mode, tag in (("0", "u64"), ("1", "mont"), ("step", "fused")):
+        out, compile_s, run_s = run_mode(mode)
+        if baseline is None:
+            baseline = out
+            match = True
+        else:
+            match = all(
+                np.array_equal(out[k], baseline[k]) for k in baseline
+            )
+        result[f"{tag}_compile_s"] = round(compile_s, 1)
+        result[f"{tag}_run_s"] = round(run_s, 3)
+        result[f"{tag}_match"] = bool(match)
+        rates[tag] = run_s
+        matched[tag] = match
+    for tag in ("mont", "fused"):
+        if matched[tag]:  # a broken kernel never gets a headline ratio
+            result[f"{tag}_over_u64"] = round(rates["u64"] / rates[tag], 3)
+    return result

@@ -88,20 +88,22 @@ def _pad_rows(v, top, total):
     return jnp.pad(v, ((top, total - v.shape[0] - top), (0, 0)))
 
 
-def _mont_mul_kernel(a_ref, b_ref, p_ref, o_ref):
-    """One TILE_M-lane block: t = a*b (schoolbook columns), then Montgomery
-    reduction clearing 30 low columns, then carry-normalize the high half."""
-    a = a_ref[:]  # (L_PAD, TILE_M) uint32, rows 30..31 zero
-    b = b_ref[0:NUM_LIMBS]  # (30, TILE_M)
+def mont_rows(a, b, p14):
+    """The Montgomery-multiply math on limb-row tiles, shared by this
+    kernel and the fused VM-step kernel (ops/pallas_step.py).
+
+    a: (L_PAD, M) uint32 with rows NUM_LIMBS.. zero; b: (NUM_LIMBS, M);
+    p14: (NUM_LIMBS, 1) modulus limbs. Returns (NUM_LIMBS, M) rows < 2^14:
+    t = a*b (schoolbook columns), Montgomery reduction clearing 30 low
+    columns, carry-normalized high half."""
     n0 = jnp.uint32(N0)
     mask = jnp.uint32(MASK)
     shift = jnp.uint32(LIMB_BITS)
-    p14 = p_ref[0:NUM_LIMBS]  # (30, 1) modulus limbs
 
     # schoolbook: t[k] = sum_{i+j=k} a_i * b_j, renormalized every 8 rows
     t = jnp.zeros((_T_ROWS, a.shape[1]), dtype=jnp.uint32)
     for i in range(NUM_LIMBS):
-        prod = a[i : i + 1] * b  # (30, TILE_M), entries < 2^28
+        prod = a[i : i + 1] * b  # (30, M), entries < 2^28
         t = t + _pad_rows(prod, i, _T_ROWS)
         if (i + 1) % _RENORM_EVERY == 0:
             t = _carry_rows(t, _T_ROWS)
@@ -111,9 +113,9 @@ def _mont_mul_kernel(a_ref, b_ref, p_ref, o_ref):
     # only the unprocessed suffix (cleared columns keep stale residuals
     # that the final high-half slice drops — fq32.py's schedule)
     for i in range(NUM_LIMBS):
-        ti = t[i : i + 1]  # (1, TILE_M)
+        ti = t[i : i + 1]  # (1, M)
         m = ((ti & mask) * n0) & mask
-        add = m * p14  # (30, TILE_M) products < 2^28
+        add = m * p14  # (30, M) products < 2^28
         carry0 = (ti + m * p14[0:1]) >> shift
         vec = jnp.concatenate([add[1:2] + carry0, add[2:]], axis=0)
         t = t + _pad_rows(vec, i + 1, _T_ROWS)
@@ -121,9 +123,14 @@ def _mont_mul_kernel(a_ref, b_ref, p_ref, o_ref):
             suffix = _carry_rows(t[i + 1 :], _T_ROWS - (i + 1))
             t = jnp.concatenate([jnp.zeros_like(t[: i + 1]), suffix], axis=0)
 
-    res = _carry_rows(t[NUM_LIMBS:], NUM_LIMBS + 1)[:NUM_LIMBS]
+    return _carry_rows(t[NUM_LIMBS:], NUM_LIMBS + 1)[:NUM_LIMBS]
+
+
+def _mont_mul_kernel(a_ref, b_ref, p_ref, o_ref):
+    """One TILE_M-lane block of the standalone mont_mul call."""
+    res = mont_rows(a_ref[:], b_ref[0:NUM_LIMBS], p_ref[0:NUM_LIMBS])
     o_ref[:] = jnp.concatenate(
-        [res, jnp.zeros((L_PAD - NUM_LIMBS, a.shape[1]), dtype=jnp.uint32)],
+        [res, jnp.zeros((L_PAD - NUM_LIMBS, res.shape[1]), dtype=jnp.uint32)],
         axis=0,
     )
 

@@ -365,12 +365,12 @@ class Program:
 _MP_PLUS_1 = fq._int_to_limbs_np(fq.MP + 1)
 
 
-def _vm_step(regs, instr):
+def _vm_step_with(mont_mul_fn, regs, instr):
     msa, msb, msd, lsa, lsb, lsub, lsd = instr
     # MUL unit
     a = jnp.take(regs, msa, axis=-2)
     b = jnp.take(regs, msb, axis=-2)
-    m = fq.mont_mul(a, b)
+    m = mont_mul_fn(a, b)
     # LIN unit: out = a + (is_sub ? (MP+1) + (MASK - b) : b), carried
     la = jnp.take(regs, lsa, axis=-2)
     lb = jnp.take(regs, lsb, axis=-2)
@@ -382,6 +382,23 @@ def _vm_step(regs, instr):
     return regs, None
 
 
+def _vm_step(regs, instr):
+    """Default scan body: the jnp u64 mont_mul lowering. Deliberately
+    NOT fq.mont_mul — that dispatcher reads the Pallas env var at trace
+    time, which would alias jit-cache entries across dispatch modes
+    (same shapes, different semantics). The mode is a static argument of
+    _vm_body instead."""
+    return _vm_step_with(fq.mont_mul_u64, regs, instr)
+
+
+def _vm_step_mont_pallas(regs, instr):
+    """Scan body with the Pallas mont_mul kernel on the u64 register
+    file (dispatch mode '1'); the LIN unit stays XLA."""
+    from . import pallas_fq
+
+    return _vm_step_with(pallas_fq.mont_mul, regs, instr)
+
+
 # lax.scan unroll factor: >1 fuses that many ALU steps per loop iteration,
 # trading compile time for less per-step loop/dispatch overhead on TPU.
 # Step counts are padded to multiples of 256 (bls_backend.PAD_STEPS), so
@@ -390,22 +407,68 @@ def _vm_step(regs, instr):
 _SCAN_UNROLL = int(os.environ.get("CONSENSUS_SPECS_TPU_SCAN_UNROLL", "1"))
 
 
-def _vm_body(inputs_u32, template, input_regs, output_regs, instr):
+def _vm_step14(regs14, instr):
+    """Scan body of the fused-Pallas mode: the register file lives in
+    14-bit uint32 limb form (ops/pallas_step.py) — half the HBM bytes per
+    gather/scatter and no u64 emulation; one kernel does both units."""
+    from . import pallas_step
+
+    msa, msb, msd, lsa, lsb, lsub, lsd = instr
+    m, lin = pallas_step.fused_step(
+        jnp.take(regs14, msa, axis=-2),
+        jnp.take(regs14, msb, axis=-2),
+        jnp.take(regs14, lsa, axis=-2),
+        jnp.take(regs14, lsb, axis=-2),
+        lsub,
+    )
+    regs14 = regs14.at[..., msd, :].set(m)
+    regs14 = regs14.at[..., lsd, :].set(lin)
+    return regs14, None
+
+
+def _vm_body(inputs_u32, template, input_regs, output_regs, instr,
+             pallas_mode="0"):
     """Device program: broadcast the (n_regs, L) const template over the
     batch, scatter the compact u32 inputs in, scan the ALU steps, and slice
     ONLY the output registers — so host<->device traffic is the compact
     input stack in and the named outputs out, never the full register file
-    (which is tens of times larger at epoch scale)."""
+    (which is tens of times larger at epoch scale).
+
+    ``pallas_mode`` (STATIC jit argument — set by execute() from
+    CONSENSUS_SPECS_TPU_PALLAS on the single-device path only; a
+    pallas_call is not GSPMD-partitionable, so the mesh runner is always
+    '0'). Making it static keys the jit cache per mode — an env flip can
+    never alias a cached executable of a different dispatch:
+      '0'    — jnp u64 lowering for both units (default);
+      '1'    — Pallas mont_mul kernel, LIN unit stays XLA;
+      'step' — the whole scan on a 14-bit uint32 register file through
+               the fused mul+lin kernel (ops/pallas_step.py); outputs
+               convert back to u64 28-bit limbs, bit-identical."""
     batch = inputs_u32.shape[:-2]
+    if pallas_mode == "step":
+        from . import pallas_step
+
+        regs14 = jnp.broadcast_to(
+            pallas_step.split14(template),
+            batch + (template.shape[0], 2 * fq.NUM_LIMBS),
+        )
+        regs14 = regs14.at[..., input_regs, :].set(
+            pallas_step.split14(inputs_u32)
+        )
+        regs14, _ = jax.lax.scan(
+            _vm_step14, regs14, instr, unroll=_SCAN_UNROLL
+        )
+        return pallas_step.join14(regs14[..., output_regs, :])
+    step = _vm_step_mont_pallas if pallas_mode == "1" else _vm_step
     regs = jnp.broadcast_to(
         template, batch + template.shape
     ).astype(jnp.uint64)
     regs = regs.at[..., input_regs, :].set(inputs_u32.astype(jnp.uint64))
-    regs, _ = jax.lax.scan(_vm_step, regs, instr, unroll=_SCAN_UNROLL)
+    regs, _ = jax.lax.scan(step, regs, instr, unroll=_SCAN_UNROLL)
     return regs[..., output_regs, :]
 
 
-_vm_run = jax.jit(_vm_body)
+_vm_run = jax.jit(_vm_body, static_argnums=(5,))
 
 
 import functools as _functools
@@ -424,7 +487,7 @@ def _vm_run_for_mesh(mesh):
     batch_sh = NamedSharding(mesh, P(mesh.axis_names))
     repl = NamedSharding(mesh, P())
     return jax.jit(
-        _vm_body,
+        _vm_body,  # use14 stays False: pallas_call is not partitionable
         in_shardings=(
             batch_sh,
             repl,
@@ -464,6 +527,13 @@ def execute(program: Program, inputs: Dict[str, np.ndarray], batch_shape=(),
     }
 
 
+def _pallas_mode() -> str:
+    """The CONSENSUS_SPECS_TPU_PALLAS dispatch mode, normalized to the
+    static _vm_body argument ('0' | '1' | 'step')."""
+    v = os.environ.get("CONSENSUS_SPECS_TPU_PALLAS", "0")
+    return v if v in ("1", "step") else "0"
+
+
 def _execute_device(stacked, template, input_regs, output_regs, instr, mesh):
     if mesh is None:
         return _vm_run(
@@ -472,6 +542,7 @@ def _execute_device(stacked, template, input_regs, output_regs, instr, mesh):
             jnp.asarray(input_regs),
             jnp.asarray(output_regs),
             instr,
+            _pallas_mode(),
         )
     from jax.sharding import NamedSharding, PartitionSpec as P
 

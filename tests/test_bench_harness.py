@@ -47,16 +47,19 @@ def test_best_line_single_mode_has_no_per_mode_key(bench):
     assert "per_mode_best" not in best
 
 
-def test_best_line_attaches_probe_and_surfaces_error(bench):
+def test_best_line_attaches_probes_and_surfaces_error(bench):
     best, err = bench._best_line(_lines(
         {"value": 500.0, "mode": "committee"},
         {"value": 0.0, "error": "epoch stage RuntimeError: device lost"},
         {"probe": "pallas_ab", "pallas_over_u64": 2.5, "pallas_chain_match": True},
+        {"probe": "vm_step_ab", "fused_over_u64": 3.0},
     ))
     # a later stage's failure must not discard the landed committee number
     assert best["value"] == 500.0
-    assert best["pallas_ab"]["pallas_over_u64"] == 2.5
-    assert "probe" not in best["pallas_ab"]
+    # BOTH probe lines survive, keyed by name, without the "probe" key
+    assert best["probes"]["pallas_ab"]["pallas_over_u64"] == 2.5
+    assert best["probes"]["vm_step_ab"]["fused_over_u64"] == 3.0
+    assert "probe" not in best["probes"]["pallas_ab"]
     assert "device lost" in err
 
 
@@ -103,9 +106,11 @@ def test_child_runs_committee_then_epoch_then_probe(bench, monkeypatch, capsys):
     assert calls[1][3] == "epoch"
     assert out[0]["value"] == 123.0 and out[0]["mode"] == "committee"
     assert any("epoch stage RuntimeError" in o.get("error", "") for o in out)
-    # probe stage still ran after the epoch failure (probe_error is fine
-    # here: the fake jax can't run a real kernel)
-    assert out[-1].get("probe") == "pallas_ab"
+    # both probe stages still ran after the epoch failure (probe_error is
+    # fine here: the fake jax can't run a real kernel)
+    assert [o["probe"] for o in out if "probe" in o] == [
+        "pallas_ab", "vm_step_ab",
+    ]
 
 
 def test_child_env_override_collapses_to_single_stage(bench, monkeypatch, capsys):
@@ -123,3 +128,48 @@ def test_child_env_override_collapses_to_single_stage(bench, monkeypatch, capsys
     out = [json.loads(l) for l in capsys.readouterr().out.strip().splitlines()]
     assert calls == [(None, True)]
     assert out[-1]["value"] == 9.0
+
+
+def test_init_watchdog_fires_on_hang(bench, monkeypatch, capsys):
+    """A backend init that outlives BENCH_INIT_DEADLINE must flush a
+    parseable error line and exit the child — the harvest loop's sampling
+    rate depends on dead attempts dying fast."""
+    import threading
+
+    monkeypatch.setenv("BENCH_INIT_DEADLINE", "0.05")
+    exited = threading.Event()
+    codes = []
+
+    def fake_exit(code):
+        codes.append(code)
+        exited.set()
+
+    class HangingJax:
+        @staticmethod
+        def default_backend():
+            exited.wait(5)  # blocks until the watchdog "exits"
+            return "tpu"
+
+    monkeypatch.setitem(sys.modules, "jax", HangingJax())
+    got = bench._init_backend_with_watchdog(exit_fn=fake_exit)
+    assert codes == [3]
+    assert got is False  # the fake backend eventually answered 'tpu'
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert "backend init exceeded" in line["error"]
+
+
+def test_init_watchdog_noop_on_fast_init(bench, monkeypatch, capsys):
+    monkeypatch.setenv("BENCH_INIT_DEADLINE", "5")
+
+    class FastJax:
+        @staticmethod
+        def default_backend():
+            return "cpu"
+
+    monkeypatch.setitem(sys.modules, "jax", FastJax())
+    codes = []
+    assert bench._init_backend_with_watchdog(exit_fn=codes.append) is True
+    import time
+
+    time.sleep(0.1)
+    assert codes == [] and capsys.readouterr().out == ""

@@ -1,0 +1,156 @@
+"""Render the executable spec sources into the per-fork markdown document
+set (docs/specs/<fork>/<doc>.md + index).
+
+The reference's markdown under specs/ is simultaneously its spec SOURCE
+and the client-team documentation; this repo authors the semantics as
+Python (specsrc/, the SURVEY §7.2-sanctioned alternative), so the
+human-readable document set is GENERATED from it instead: one markdown
+document per specsrc module, with the module's section banners as
+headings, constants grouped into tables-of-code, and every container and
+function as an anchored, navigable block. `make docs` regenerates;
+tests/test_render_spec.py checks the tree stays complete.
+"""
+import ast
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+SPECSRC = os.path.join(REPO, "consensus_specs_tpu", "specsrc")
+OUT = os.path.join(REPO, "docs", "specs")
+
+_TITLES = {
+    "beacon_chain": "The Beacon Chain",
+    "fork_choice": "Fork Choice",
+    "validator": "Honest Validator",
+    "p2p": "Networking (computable parts)",
+    "weak_subjectivity": "Weak Subjectivity",
+    "fork": "Fork Transition",
+    "bls": "BLS Extensions",
+    "sync_protocol": "Light Client Sync Protocol",
+    "das": "Data Availability Sampling",
+    "custody_game": "Custody Game",
+    "shard_transition": "Shard Transition",
+}
+
+
+def _sections(src: str):
+    """(lineno, title) for every `# --- / # Title / # ---` banner."""
+    lines = src.splitlines()
+    out = []
+    for i, line in enumerate(lines):
+        if re.match(r"#\s*-{10,}", line) and i + 1 < len(lines):
+            m = re.match(r"#\s+(.+)", lines[i + 1])
+            if m and not re.match(r"-{5,}", m.group(1)):
+                out.append((i + 1, m.group(1).strip()))
+    return out
+
+
+def _header_comment(src: str) -> str:
+    out = []
+    for line in src.splitlines():
+        if re.match(r"#\s*-{10,}", line):
+            break  # the first section banner ends the header
+        if line.startswith("#"):
+            out.append(line.lstrip("# ").rstrip())
+        elif line.strip():
+            break
+    return "\n".join(out).strip()
+
+
+def render_module(fork: str, name: str, src: str) -> str:
+    tree = ast.parse(src)
+    sections = _sections(src)
+    title = _TITLES.get(name, name.replace("_", " ").title())
+
+    md = [f"# {fork} — {title}", ""]
+    header = _header_comment(src)
+    if header:
+        md += [header, ""]
+
+    def section_for(lineno: int):
+        current = None
+        for sec_line, sec_title in sections:
+            if sec_line < lineno:
+                current = sec_title
+            else:
+                break
+        return current
+
+    emitted_sections = set()
+    const_run = []  # accumulated top-level assignment source lines
+    src_lines = src.splitlines()
+
+    def flush_consts():
+        if const_run:
+            md.append("```python")
+            md.extend(const_run)
+            md.append("```")
+            md.append("")
+            const_run.clear()
+
+    for node in tree.body:
+        sec = section_for(node.lineno)
+        if sec is not None and sec not in emitted_sections:
+            flush_consts()
+            emitted_sections.add(sec)
+            md.append(f"## {sec}")
+            md.append("")
+        seg = src_lines[node.lineno - 1 : node.end_lineno]
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            const_run.extend(seg)
+        elif isinstance(node, (ast.ClassDef, ast.FunctionDef)):
+            flush_consts()
+            kind = "class" if isinstance(node, ast.ClassDef) else "def"
+            md.append(f"### `{node.name}`" + (" (container)" if kind == "class" else ""))
+            md.append("")
+            md.append("```python")
+            md.extend(seg)
+            md.append("```")
+            md.append("")
+    flush_consts()
+    return "\n".join(md) + "\n"
+
+
+def main() -> int:
+    index = [
+        "# Specification documents",
+        "",
+        "Generated from the executable spec sources (`consensus_specs_tpu/"
+        "specsrc/`) by `make docs` — do not edit by hand; the Python IS the "
+        "normative spec, these documents are its reviewable rendering.",
+        "",
+    ]
+    total = 0
+    for fork in sorted(os.listdir(SPECSRC)):
+        fork_dir = os.path.join(SPECSRC, fork)
+        if not os.path.isdir(fork_dir) or fork.startswith("__"):
+            continue
+        index.append(f"## {fork}")
+        index.append("")
+        out_dir = os.path.join(OUT, fork)
+        os.makedirs(out_dir, exist_ok=True)
+        for fn in sorted(os.listdir(fork_dir)):
+            if not fn.endswith(".py") or fn.startswith("__"):
+                continue
+            name = fn[:-3]
+            with open(os.path.join(fork_dir, fn)) as f:
+                src = f.read()
+            doc = render_module(fork, name, src)
+            out_path = os.path.join(out_dir, f"{name}.md")
+            with open(out_path, "w") as f:
+                f.write(doc)
+            rel = os.path.relpath(out_path, OUT)
+            index.append(f"- [{_TITLES.get(name, name)}]({rel})")
+            total += 1
+        index.append("")
+    with open(os.path.join(OUT, "index.md"), "w") as f:
+        f.write("\n".join(index) + "\n")
+    print(f"rendered {total} spec documents under {os.path.relpath(OUT, REPO)}/")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
