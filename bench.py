@@ -328,7 +328,13 @@ def main():
                 _emit(0.0, 0.0, error=f"{type(e).__name__}: {e}")
             return
         for stage_override in (
-            (32, 128, 3, "committee"),  # proven: compile + 3 reps < 420 s
+            # stage 0: tiny shape — its small-bucket program compiles in
+            # well under a minute, so a nonzero TPU number lands almost
+            # immediately after any grant (the round-3 "compile + 3 reps
+            # < 420 s" proof predates lane folding; the folded committee
+            # program's TPU compile time is unmeasured)
+            (4, 8, 1, "committee"),
+            (32, 128, 3, "committee"),  # the round-over-round fixed shape
             (0, 0, 1, "epoch"),  # north-star workload; per-rep emission
         ):
             try:

@@ -102,3 +102,29 @@ def test_custody_key_reveal_max_decrement_when_slashed(spec, state):
     reveal = get_valid_custody_key_reveal(spec, state)
     state.validators[reveal.revealer_index].slashed = True
     yield from run_custody_key_reveal_processing(spec, state, reveal, valid=False)
+
+
+@with_phases([CUSTODY_GAME])
+@spec_state_test
+@always_bls
+@disable_process_reveal_deadlines
+def test_custody_key_reveal_corrupted_signature(spec, state):
+    # right period, right revealer — but the reveal itself is not the
+    # revealer's BLS signature over the period epoch
+    _advance_periods(spec, state, 1)
+    reveal = get_valid_custody_key_reveal(spec, state)
+    sig = bytearray(bytes(reveal.reveal))
+    sig[-1] ^= 0x01
+    reveal.reveal = sig
+    yield from run_custody_key_reveal_processing(spec, state, reveal, valid=False)
+
+
+@with_phases([CUSTODY_GAME])
+@spec_state_test
+@disable_process_reveal_deadlines
+def test_custody_key_reveal_ghost_revealer(spec, state):
+    # a revealer index one past the registry must be refused outright
+    _advance_periods(spec, state, 1)
+    reveal = get_valid_custody_key_reveal(spec, state)
+    reveal.revealer_index = len(state.validators)
+    yield from run_custody_key_reveal_processing(spec, state, reveal, valid=False)

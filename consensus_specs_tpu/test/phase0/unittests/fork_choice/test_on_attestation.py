@@ -120,3 +120,79 @@ def test_on_tick_mid_epoch_no_promotion(spec, state):
     # tick to a mid-epoch slot only
     spec.on_tick(store, slot_time(spec, store, spec.SLOTS_PER_EPOCH - 1))
     assert store.justified_checkpoint != better
+
+
+@with_all_phases
+@spec_state_test
+def test_on_attestation_same_epoch_does_not_override(spec, state):
+    # LMD stores at most one message per validator and replaces it only
+    # for a STRICTLY newer target epoch (fork-choice.md on_attestation):
+    # the same committee voting for a competing block in the same epoch
+    # must leave the first votes standing
+    store = get_genesis_forkchoice_store(spec, state)
+    state_a, state_b = state.copy(), state.copy()
+    block_a = build_empty_block_for_next_slot(spec, state_a)
+    block_a.body.graffiti = b"\x0a" + b"\x00" * 31
+    signed_a = state_transition_and_sign_block(spec, state_a, block_a)
+    block_b = build_empty_block_for_next_slot(spec, state_b)
+    block_b.body.graffiti = b"\x0b" + b"\x00" * 31
+    signed_b = state_transition_and_sign_block(spec, state_b, block_b)
+    spec.on_tick(store, slot_time(spec, store, block_a.slot + 1))
+    spec.on_block(store, signed_a)
+    spec.on_block(store, signed_b)
+
+    att_a = get_valid_attestation(spec, state_a, slot=block_a.slot, signed=True)
+    run_on_attestation(spec, store, att_a)
+    root_a = att_a.data.beacon_block_root
+    voters = list(spec.get_indexed_attestation(state_a, att_a).attesting_indices)
+
+    # the two forks share the epoch's shuffling, so the SAME validators
+    # now vote for block B at the same target epoch
+    att_b = get_valid_attestation(spec, state_b, slot=block_b.slot, signed=True)
+    assert att_b.data.target.epoch == att_a.data.target.epoch
+    assert att_b.data.beacon_block_root != root_a
+    run_on_attestation(spec, store, att_b)
+
+    for v in voters:
+        assert store.latest_messages[v].root == root_a
+
+
+@with_all_phases
+@spec_state_test
+def test_on_attestation_newer_epoch_overrides(spec, state):
+    # ...but the same validator's NEXT-epoch vote replaces the stored
+    # message — the property that lets honest validators move the head
+    from ....helpers.state import next_epoch, transition_to
+
+    store = get_genesis_forkchoice_store(spec, state)
+    block = build_empty_block_for_next_slot(spec, state)
+    signed = state_transition_and_sign_block(spec, state, block)
+    spec.on_tick(store, slot_time(spec, store, block.slot + 1))
+    spec.on_block(store, signed)
+
+    att1 = get_valid_attestation(spec, state, slot=block.slot, signed=True)
+    run_on_attestation(spec, store, att1)
+    victim = int(spec.get_indexed_attestation(state, att1).attesting_indices[0])
+    first = store.latest_messages[victim]
+
+    # find the victim's committee seat in the next epoch
+    next_epoch(spec, state)
+    epoch = spec.get_current_epoch(state)
+    start = spec.compute_start_slot_at_epoch(epoch)
+    seat = next(
+        (slot, ci)
+        for slot in range(start, start + spec.SLOTS_PER_EPOCH)
+        for ci in range(spec.get_committee_count_per_slot(state, epoch))
+        if victim in spec.get_beacon_committee(state, slot, ci)
+    )
+    transition_to(spec, state, seat[0])
+    att2 = get_valid_attestation(
+        spec, state, slot=seat[0], index=seat[1], signed=True,
+        filter_participant_set=lambda committee: {victim},
+    )
+    spec.on_tick(store, slot_time(spec, store, seat[0] + 1))
+    run_on_attestation(spec, store, att2)
+
+    got = store.latest_messages[victim]
+    assert got.epoch == att2.data.target.epoch
+    assert got.epoch > first.epoch

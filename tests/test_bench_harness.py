@@ -102,8 +102,9 @@ def test_child_runs_committee_then_epoch_then_probe(bench, monkeypatch, capsys):
     bench.main()
     out = [json.loads(l) for l in capsys.readouterr().out.strip().splitlines()]
 
-    assert calls[0] == (32, 128, 3, "committee")
-    assert calls[1][3] == "epoch"
+    assert calls[0] == (4, 8, 1, "committee")  # instant first TPU number
+    assert calls[1] == (32, 128, 3, "committee")
+    assert calls[2][3] == "epoch"
     assert out[0]["value"] == 123.0 and out[0]["mode"] == "committee"
     assert any("epoch stage RuntimeError" in o.get("error", "") for o in out)
     # both probe stages still ran after the epoch failure (probe_error is
