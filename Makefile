@@ -9,10 +9,12 @@ GENERATORS = operations sanity epoch_processing rewards finality forks transitio
 
 # sweep split: state-machine-heavy runners emit minimal-preset only (the
 # reference's CI posture); cheap runners emit every preset they define —
-# shuffling/bls/ssz_generic/genesis/merkle cover mainnet/general too
+# shuffling/bls/ssz_generic/merkle cover mainnet/general too. genesis is
+# heavy: its mainnet initialization cases build 16k+-validator states
+# through per-deposit processing (hours of single-core time, measured)
 HEAVY_GENERATORS = operations sanity epoch_processing rewards finality forks transition \
-                   random fork_choice ssz_static
-CHEAP_GENERATORS = shuffling bls ssz_generic genesis merkle
+                   random fork_choice ssz_static genesis
+CHEAP_GENERATORS = shuffling bls ssz_generic merkle
 
 .PHONY: test citest test_tpu_backend lint generate_tests \
         detect_generator_incomplete check_vectors bench multichip clean_vectors \
