@@ -17,8 +17,8 @@ HEAVY_GENERATORS = operations sanity epoch_processing rewards finality forks tra
 CHEAP_GENERATORS = shuffling bls ssz_generic merkle
 
 .PHONY: test citest test_tpu_backend lint generate_tests \
-        detect_generator_incomplete check_vectors bench multichip clean_vectors \
-        generate_random_tests
+        detect_generator_incomplete check_vectors bench serve-bench multichip \
+        clean_vectors generate_random_tests
 
 # fast default: BLS stubbed except @always_bls, 4-way process-parallel
 # (reference `make test` = pytest -n 4, reference Makefile:100)
@@ -81,6 +81,14 @@ check_vectors:
 
 bench:
 	python bench.py
+
+# streaming serve plane (consensus_specs_tpu/serve/): short CPU-sized
+# synthetic gossip load — Poisson arrivals, duplicate-heavy traffic, one
+# injected backend failure — through the continuous-batching
+# VerificationService; emits one JSON line with sustained signatures/sec,
+# batch occupancy, cache hit rate, and p50/p95/p99 submit->result latency
+serve-bench:
+	JAX_PLATFORMS=cpu python bench.py --mode serve
 
 multichip:
 	python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('multichip OK')"

@@ -38,6 +38,14 @@ same fixed shape so CPU numbers trend round-over-round. Env overrides
 always win and collapse the child to a single stage: BENCH_MODE
 ("committee" | "epoch"), BENCH_N, BENCH_K, BENCH_REPS,
 BENCH_PROBE_TIMEOUT (seconds for the whole accelerator attempt).
+
+`--mode serve` is separate from the committee/epoch machinery: it drives a
+synthetic Poisson gossip load (duplicate-heavy, with an injected backend
+failure) through the streaming VerificationService
+(consensus_specs_tpu/serve/) in-process on CPU, and its JSON line carries
+sustained signatures/sec plus the serving numbers — batch occupancy, cache
+hit rate, p50/p95/p99 submit->result latency (knobs: SERVE_* env vars, see
+serve/load.py).
 """
 import json
 import os
@@ -302,7 +310,33 @@ def _run_child_attempt(timeout: float):
     return None, f"accelerator attempt rc={rc}: {' | '.join(err_tail)}"
 
 
+def _cli_mode():
+    """`--mode <m>` / `--mode=<m>` from argv (bench.py's only CLI flag)."""
+    argv = sys.argv[1:]
+    for i, arg in enumerate(argv):
+        if arg == "--mode" and i + 1 < len(argv):
+            return argv[i + 1]
+        if arg.startswith("--mode="):
+            return arg.split("=", 1)[1]
+    return None
+
+
 def main():
+    if _cli_mode() == "serve":
+        # streaming serve-plane bench, in-process and CPU-forced: the
+        # deadline-guarded child exists because in-process accelerator
+        # attempts can hang for minutes (TPU_NOTES.md), and the serve
+        # line's value is the service-layer numbers (occupancy, cache hit
+        # rate, latency percentiles) on a CPU-sized load — SERVE_* env
+        # vars scale it up inside a granted window
+        from consensus_specs_tpu.utils.jax_env import force_cpu
+
+        force_cpu()
+        from consensus_specs_tpu.serve.load import run_serve_bench
+
+        _emit_result(run_serve_bench())
+        return
+
     if os.environ.get(_CHILD_FLAG) == "1":
         # child: run on the inherited platform, flushing a refreshed JSON
         # line at every stage; a crash/device error becomes a JSON error

@@ -41,6 +41,9 @@ from typing import List, Sequence, Tuple
 
 import numpy as np
 
+# hoisted to module scope (was re-imported inside the per-check loop of
+# _bucket_of on every flush)
+from .ops.bls_backend import _k_bucket
 from .utils import bls
 
 
@@ -190,39 +193,84 @@ class SignatureCollector:
 
     # -- batched resolution -------------------------------------------------
 
-    def flush(self, backend=None, mesh=None) -> np.ndarray:
+    def _unique_checks(self) -> Tuple[List[int], List[List[int]]]:
+        """Dedup identical recorded checks: the same attestation included
+        in multiple blocks is one verification, fanned out to every
+        occurrence. Returns (first-occurrence indices in record order,
+        per-unique member index lists)."""
+        order: List[int] = []
+        members: List[List[int]] = []
+        seen = {}
+        for i, c in enumerate(self.checks):
+            key = _dedup_key(c)
+            u = seen.get(key)
+            if u is None:
+                seen[key] = len(order)
+                order.append(i)
+                members.append([i])
+            else:
+                members[u].append(i)
+        return order, members
+
+    def flush(self, backend=None, mesh=None, service=None) -> np.ndarray:
         """Verify all recorded checks; returns a bool array in record order.
 
-        Checks are grouped by (kind, K-bucket) so each device batch pads to
-        its own committee-size bucket (ops/bls_backend.py _K_BUCKETS).
-        With ``mesh``, each bucket's batch axis is sharded over the mesh
-        (SURVEY §2.7/P1 — the committee axis is the DP axis)."""
+        Identical checks (same kind/pubkeys/message(s)/signature) are
+        verified ONCE and the result fanned out to every occurrence.
+
+        With ``service`` (a serve.VerificationService), the unique checks
+        ride the streaming plane — micro-batched with whatever else the
+        service is carrying, cached, deduped against other submitters.
+        Otherwise checks are grouped by (kind, K-bucket) so each device
+        batch pads to its own committee-size bucket (ops/bls_backend.py
+        _K_BUCKETS). With ``mesh``, each bucket's batch axis is sharded
+        over the mesh (SURVEY §2.7/P1 — the committee axis is the DP
+        axis)."""
+        out = np.zeros(len(self.checks), dtype=bool)
+        order, members = self._unique_checks()
+
+        if service is not None:
+            if backend is not None or mesh is not None:
+                raise ValueError(
+                    "flush(service=...) uses the service's own backend and "
+                    "sharding; pass backend/mesh to the VerificationService "
+                    "instead"
+                )
+            futures = [
+                service.submit(c.kind, c.pubkeys, c.messages, c.signature)
+                for c in (self.checks[i] for i in order)
+            ]
+            for m, fut in zip(members, futures):
+                out[m] = bool(fut.result())
+            return out
+
         if backend is None:
             from .ops import bls_backend as backend  # noqa: F811
 
-        out = np.zeros(len(self.checks), dtype=bool)
         groups = {}
-        for i, c in enumerate(self.checks):
+        for u, i in enumerate(order):
+            c = self.checks[i]
             key = (c.kind, _bucket_of(len(c.pubkeys)))
-            groups.setdefault(key, []).append(i)
+            groups.setdefault(key, []).append(u)
 
-        for (kind, _bucket), idxs in groups.items():
+        for (kind, _bucket), uidxs in groups.items():
+            checks = [self.checks[order[u]] for u in uidxs]
             if kind == "fast_aggregate":
                 res = backend.batch_fast_aggregate_verify(
-                    [self.checks[i].pubkeys for i in idxs],
-                    [self.checks[i].messages for i in idxs],
-                    [self.checks[i].signature for i in idxs],
+                    [c.pubkeys for c in checks],
+                    [c.messages for c in checks],
+                    [c.signature for c in checks],
                     mesh=mesh,
                 )
             else:
                 res = backend.batch_aggregate_verify(
-                    [self.checks[i].pubkeys for i in idxs],
-                    [self.checks[i].messages for i in idxs],
-                    [self.checks[i].signature for i in idxs],
+                    [c.pubkeys for c in checks],
+                    [c.messages for c in checks],
+                    [c.signature for c in checks],
                     mesh=mesh,
                 )
-            for j, i in enumerate(idxs):
-                out[i] = bool(res[j])
+            for r, u in zip(res, uidxs):
+                out[members[u]] = bool(r)
         return out
 
     def flush_oracle(self) -> np.ndarray:
@@ -238,9 +286,12 @@ class SignatureCollector:
 
 
 def _bucket_of(k: int) -> int:
-    from .ops.bls_backend import _k_bucket
-
     return _k_bucket(max(1, k))
+
+
+def _dedup_key(c: CollectedCheck):
+    msgs = c.messages if isinstance(c.messages, bytes) else tuple(c.messages)
+    return (c.kind, tuple(c.pubkeys), msgs, c.signature)
 
 
 def replay_blocks_batched(spec, state, signed_blocks: Sequence) -> np.ndarray:
@@ -265,3 +316,40 @@ def feed_attestations_batched(spec, store, attestations: Sequence) -> np.ndarray
         for attestation in attestations:
             spec.on_attestation(store, attestation)
     return col.flush()
+
+
+def feed_attestations_streamed(spec, store, attestations, service=None
+                               ) -> np.ndarray:
+    """Streaming twin of ``feed_attestations_batched``: attestations come
+    from an ITERATOR (a live gossip feed), and each recorded check is
+    submitted to the serve plane the moment it is recorded — verification
+    overlaps ingestion instead of waiting for the span to end, and
+    duplicates across the stream (the same aggregate from many peers) are
+    verified once by the service's cache/dedup layer.
+
+    With ``service=None`` a private VerificationService is created for
+    the call (constructed BEFORE the collector context so its fallback
+    oracle captures the real bls functions) and drained afterwards.
+    Returns the per-check bool array in record order, exactly like the
+    batched feeder."""
+    owned = service is None
+    if owned:
+        from .serve import VerificationService
+
+        service = VerificationService()
+    futures = []
+    try:
+        with SignatureCollector(spec) as col:
+            n_seen = 0
+            for attestation in attestations:
+                spec.on_attestation(store, attestation)
+                for c in col.checks[n_seen:]:
+                    futures.append(
+                        service.submit(c.kind, c.pubkeys, c.messages,
+                                       c.signature)
+                    )
+                n_seen = len(col.checks)
+        return np.array([bool(f.result()) for f in futures], dtype=bool)
+    finally:
+        if owned:
+            service.close()

@@ -545,6 +545,25 @@ def _run_hard_part(g_flat_batch: np.ndarray, mesh=None) -> np.ndarray:
 # batched public API
 # ---------------------------------------------------------------------------
 
+# entry-point instrumentation: batch calls + per-item verifications, used
+# by the serve plane's dedup assertions ("every duplicate verified exactly
+# once") and attached to serve-bench JSON lines
+CALL_COUNTS = {
+    "batch_fast_aggregate_verify": 0,
+    "batch_aggregate_verify": 0,
+    "items": 0,
+}
+
+
+def _count_call(name: str, n_items: int) -> None:
+    CALL_COUNTS[name] += 1
+    CALL_COUNTS["items"] += n_items
+
+
+def reset_call_counts() -> None:
+    for k in CALL_COUNTS:
+        CALL_COUNTS[k] = 0
+
 
 def batch_fast_aggregate_verify(
     pubkey_sets: Sequence[Sequence[bytes]],
@@ -558,6 +577,7 @@ def batch_fast_aggregate_verify(
     With ``mesh``, the batch axis is sharded over its first mesh axis."""
     n = len(pubkey_sets)
     assert len(messages) == n and len(signatures) == n
+    _count_call("batch_fast_aggregate_verify", n)
     if n == 0:
         return np.zeros(0, dtype=bool)
     max_k = max((len(pks) for pks in pubkey_sets), default=1)
@@ -630,6 +650,7 @@ def batch_aggregate_verify(
     proper subfield, killed by the final exponentiation).
     With ``mesh``, the batch axis is sharded over its first mesh axis."""
     n = len(pubkey_lists)
+    _count_call("batch_aggregate_verify", n)
     if n == 0:
         return np.zeros(0, dtype=bool)
     max_k = max(

@@ -4,17 +4,26 @@ per-kernel timing and an XLA trace hook).
 
 - ``record(...)`` is called by vm.execute around every device program run;
   stats accumulate per (program kind, batch shape) in-process.
-- ``summary()``/``report()`` expose them; bench.py attaches the summary to
-  its JSON line when CONSENSUS_SPECS_TPU_PROFILE=1.
+- ``record_latency(...)`` feeds a bounded-reservoir percentile tracker —
+  mean/max cannot express a serving SLO, so the serve plane's
+  submit->result latencies report p50/p95/p99 (nearest-rank over an
+  Algorithm-R reservoir; deterministic seed so reruns are comparable).
+- ``set_gauge(...)`` publishes point-in-time values (queue depth, cache
+  hit rate, batch occupancy) from the serve plane.
+- ``summary()``/``report()`` expose all three; bench.py attaches the
+  summary to its JSON line when CONSENSUS_SPECS_TPU_PROFILE=1 (the serve
+  bench mode attaches it always).
 - ``trace(path)`` wraps a block in jax.profiler's trace for TensorBoard /
   xprof when deeper inspection is wanted (no-op if the profiler is
   unavailable on the platform).
 """
 import contextlib
 import os
+import random
+import threading
 import time
 from collections import defaultdict
-from typing import Dict
+from typing import Dict, List
 
 ENABLED = os.environ.get("CONSENSUS_SPECS_TPU_PROFILE") == "1"
 
@@ -22,12 +31,75 @@ _stats: Dict[str, Dict[str, float]] = defaultdict(
     lambda: {"calls": 0, "total_s": 0.0, "max_s": 0.0}
 )
 
+RESERVOIR_CAP = 4096
+
+_lat: Dict[str, Dict] = defaultdict(
+    lambda: {"count": 0, "total_s": 0.0, "max_s": 0.0, "sample": []}
+)
+_lat_rng = random.Random(0x5EED)  # deterministic: reruns sample identically
+# one lock for every accumulator: the serve plane writes timings, gauges
+# AND latencies concurrently from submit threads and its worker, so an
+# unlocked summary() could see a dict resize mid-iteration
+_lock = threading.Lock()
+_gauges: Dict[str, float] = {}
+
 
 def record(label: str, seconds: float) -> None:
-    s = _stats[label]
-    s["calls"] += 1
-    s["total_s"] += seconds
-    s["max_s"] = max(s["max_s"], seconds)
+    with _lock:
+        s = _stats[label]
+        s["calls"] += 1
+        s["total_s"] += seconds
+        s["max_s"] = max(s["max_s"], seconds)
+
+
+def record_latency(label: str, seconds: float) -> None:
+    """Feed one latency observation into ``label``'s bounded reservoir
+    (Algorithm R: every observation has equal probability of being in the
+    sample, so percentiles stay unbiased at any stream length)."""
+    with _lock:
+        s = _lat[label]
+        s["count"] += 1
+        s["total_s"] += seconds
+        s["max_s"] = max(s["max_s"], seconds)
+        sample: List[float] = s["sample"]
+        if len(sample) < RESERVOIR_CAP:
+            sample.append(seconds)
+        else:
+            j = _lat_rng.randrange(s["count"])
+            if j < RESERVOIR_CAP:
+                sample[j] = seconds
+
+
+def _percentile(sorted_sample: List[float], q: float) -> float:
+    """Nearest-rank percentile over an ascending sample."""
+    if not sorted_sample:
+        return 0.0
+    rank = max(1, -(-int(q * len(sorted_sample)) // 100))  # ceil(q*n/100)
+    rank = min(rank, len(sorted_sample))
+    return sorted_sample[rank - 1]
+
+
+def latency_summary() -> Dict[str, Dict[str, float]]:
+    out = {}
+    with _lock:
+        snap = {label: (s["count"], s["total_s"], s["max_s"], list(s["sample"]))
+                for label, s in _lat.items()}
+    for label, (count, total_s, max_s, raw) in sorted(snap.items()):
+        sample = sorted(raw)
+        out[label] = {
+            "count": int(count),
+            "mean_ms": round(total_s / max(1, count) * 1e3, 3),
+            "p50_ms": round(_percentile(sample, 50) * 1e3, 3),
+            "p95_ms": round(_percentile(sample, 95) * 1e3, 3),
+            "p99_ms": round(_percentile(sample, 99) * 1e3, 3),
+            "max_ms": round(max_s * 1e3, 3),
+        }
+    return out
+
+
+def set_gauge(label: str, value: float) -> None:
+    with _lock:
+        _gauges[label] = round(float(value), 6)
 
 
 @contextlib.contextmanager
@@ -40,28 +112,47 @@ def timed(label: str):
 
 
 def summary() -> Dict[str, Dict[str, float]]:
-    return {
+    with _lock:
+        stats = {k: dict(v) for k, v in _stats.items()}
+        gauges = dict(_gauges)
+    out = {
         k: {
             "calls": int(v["calls"]),
             "total_s": round(v["total_s"], 4),
             "mean_s": round(v["total_s"] / max(1, v["calls"]), 4),
             "max_s": round(v["max_s"], 4),
         }
-        for k, v in sorted(_stats.items())
+        for k, v in sorted(stats.items())
     }
+    out.update(latency_summary())
+    for label, value in sorted(gauges.items()):
+        out[label] = {"gauge": value}
+    return out
 
 
 def reset() -> None:
-    _stats.clear()
+    with _lock:
+        _stats.clear()
+        _lat.clear()
+        _gauges.clear()
 
 
 def report() -> str:
     lines = ["device-pipeline timing:"]
     for label, s in summary().items():
-        lines.append(
-            f"  {label}: {s['calls']} calls, mean {s['mean_s']*1e3:.1f}ms, "
-            f"max {s['max_s']*1e3:.1f}ms, total {s['total_s']:.2f}s"
-        )
+        if "gauge" in s:
+            lines.append(f"  {label}: {s['gauge']}")
+        elif "p95_ms" in s:
+            lines.append(
+                f"  {label}: {s['count']} obs, p50 {s['p50_ms']:.1f}ms, "
+                f"p95 {s['p95_ms']:.1f}ms, p99 {s['p99_ms']:.1f}ms, "
+                f"max {s['max_ms']:.1f}ms"
+            )
+        else:
+            lines.append(
+                f"  {label}: {s['calls']} calls, mean {s['mean_s']*1e3:.1f}ms, "
+                f"max {s['max_s']*1e3:.1f}ms, total {s['total_s']:.2f}s"
+            )
     return "\n".join(lines)
 
 

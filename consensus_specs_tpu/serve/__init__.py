@@ -1,0 +1,15 @@
+"""Serve plane: continuous micro-batching ingress for the BLS backend.
+
+Turns the offline collect-then-flush verification plane into a live
+streaming service: bounded ingress queue -> (kind, K-bucket) grouped
+micro-batches (flush on size OR deadline) -> batched device verification
+with oracle fallback -> content-keyed result cache + in-flight dedup.
+See service.py for the dataflow and COMPONENTS.md's "Serve plane" row.
+"""
+from .cache import ResultCache, check_key  # noqa: F401
+from .metrics import ServeMetrics  # noqa: F401
+from .service import (  # noqa: F401
+    QueueFull,
+    ServiceClosed,
+    VerificationService,
+)

@@ -1,0 +1,142 @@
+"""Serve-plane observability: queue depth, batch occupancy, cache hit
+rate, and submit->result latency percentiles.
+
+Everything is exported through ``ops/profiling`` (gauges +
+``record_latency``) so ``profiling.summary()`` — and therefore every
+bench JSON line that attaches it — carries the serving SLO numbers
+without bench needing to know the service's internals.
+"""
+import threading
+from typing import Dict
+
+from ..ops import profiling
+
+LATENCY_LABEL = "serve.submit_to_result"
+BATCH_LABEL = "serve.batch_flush"
+
+
+def _pow2(n: int) -> int:
+    b = 1
+    while b < n:
+        b <<= 1
+    return b
+
+
+class ServeMetrics:
+    """Counters for one VerificationService instance.
+
+    Occupancy is tracked on two axes, both of which cost real device time
+    when wasted:
+    - ROW occupancy: filled batch rows / padded rows (the backend rounds
+      the batch axis up to a power of two);
+    - LANE occupancy: actual committee keys / (rows * K bucket) (each item
+      pads its key axis up to its bucket).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.submits = 0
+        self.eager = 0  # resolved at submit time by the reference's own rules
+        self.cache_hits = 0
+        self.inflight_joins = 0
+        self.enqueued = 0
+        self.batches = 0
+        self.rows_filled = 0
+        self.rows_padded = 0
+        self.lanes_filled = 0
+        self.lanes_padded = 0
+        self.backend_retries = 0
+        self.fallback_batches = 0
+        self.fallback_items = 0
+        self.queue_depth_peak = 0
+
+    # -- recording hooks (service.py) --------------------------------------
+
+    def note_submit(self) -> None:
+        with self._lock:
+            self.submits += 1
+
+    def note_eager(self) -> None:
+        with self._lock:
+            self.eager += 1
+
+    def note_cache_hit(self) -> None:
+        with self._lock:
+            self.cache_hits += 1
+
+    def note_inflight_join(self) -> None:
+        with self._lock:
+            self.inflight_joins += 1
+
+    def note_enqueued(self, queue_depth: int) -> None:
+        with self._lock:
+            self.enqueued += 1
+            self.queue_depth_peak = max(self.queue_depth_peak, queue_depth)
+        profiling.set_gauge("serve.queue_depth", queue_depth)
+
+    def note_batch(self, n_items: int, sum_k: int, bucket: int,
+                   seconds: float) -> None:
+        rows = _pow2(max(1, n_items))
+        with self._lock:
+            self.batches += 1
+            self.rows_filled += n_items
+            self.rows_padded += rows
+            self.lanes_filled += sum_k
+            self.lanes_padded += rows * bucket
+        profiling.record(BATCH_LABEL, seconds)
+
+    def note_retry(self) -> None:
+        with self._lock:
+            self.backend_retries += 1
+
+    def note_fallback(self, n_items: int) -> None:
+        with self._lock:
+            self.fallback_batches += 1
+            self.fallback_items += n_items
+
+    def note_result(self, latency_s: float) -> None:
+        profiling.record_latency(LATENCY_LABEL, latency_s)
+
+    # -- derived views ------------------------------------------------------
+
+    @property
+    def hit_rate(self) -> float:
+        """Share of non-eager submits answered without new backend work
+        (completed-result cache hits + in-flight dedup joins)."""
+        served = self.submits - self.eager
+        return (self.cache_hits + self.inflight_joins) / served if served else 0.0
+
+    @property
+    def row_occupancy(self) -> float:
+        return self.rows_filled / self.rows_padded if self.rows_padded else 0.0
+
+    @property
+    def lane_occupancy(self) -> float:
+        return self.lanes_filled / self.lanes_padded if self.lanes_padded else 0.0
+
+    def export_gauges(self) -> None:
+        """Publish the derived ratios into profiling.summary()."""
+        profiling.set_gauge("serve.cache_hit_rate", self.hit_rate)
+        profiling.set_gauge("serve.occupancy_rows", self.row_occupancy)
+        profiling.set_gauge("serve.occupancy_lanes", self.lane_occupancy)
+
+    def snapshot(self) -> Dict[str, float]:
+        self.export_gauges()
+        lat = profiling.latency_summary().get(LATENCY_LABEL, {})
+        with self._lock:
+            return {
+                "submits": self.submits,
+                "eager": self.eager,
+                "enqueued": self.enqueued,
+                "cache_hits": self.cache_hits,
+                "inflight_joins": self.inflight_joins,
+                "cache_hit_rate": round(self.hit_rate, 4),
+                "batches": self.batches,
+                "occupancy_rows": round(self.row_occupancy, 4),
+                "occupancy_lanes": round(self.lane_occupancy, 4),
+                "backend_retries": self.backend_retries,
+                "fallback_batches": self.fallback_batches,
+                "fallback_items": self.fallback_items,
+                "queue_depth_peak": self.queue_depth_peak,
+                "latency": lat,
+            }

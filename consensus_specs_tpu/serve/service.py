@@ -1,0 +1,340 @@
+"""Continuous micro-batching verification service for the BLS plane.
+
+The batched entry points (`ops/bls_backend.py`, driven offline through
+`batch_verify.SignatureCollector`) verify a whole recorded span in one
+shot. A live node does not have a span: it has a STREAM of gossip
+aggregates arriving one at a time, each wanting an answer under a latency
+deadline. Committee-based consensus throughput is bounded by exactly this
+aggregate-verification loop (arXiv:2302.00418), and the fix is the same
+continuous-batching shape every inference-serving stack uses:
+
+  submit() -> bounded ingress queue -> background worker forms a batch
+  (flush on max_batch OR max_wait_ms, whichever first) -> requests are
+  grouped by (kind, K bucket) so padded device shapes reuse the existing
+  jit/VM program cache -> one batched backend call per group -> futures
+  resolve.
+
+Robustness: a device error on a batch is retried once (transient), then
+the whole group degrades to the pure-Python oracle sequentially — a
+poisoned batch costs latency, never stream correctness, and never a lost
+request. Duplicate content (the same aggregate from many gossip peers) is
+answered by the result LRU or, while still in flight, by sharing the
+first submitter's Future (`cache.py`) — the backend sees each distinct
+check exactly once.
+
+NOTE: construct the service OUTSIDE any active SignatureCollector
+context — the default fallback oracle is captured from the bls
+switchboard at __init__ time, and inside a collector those names are the
+recording interceptors.
+"""
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import List, Optional
+
+from ..ops import profiling
+from .cache import ResultCache, check_key
+from .metrics import ServeMetrics
+
+KINDS = ("fast_aggregate", "aggregate")
+
+
+class ServiceClosed(RuntimeError):
+    """submit() after close(): the stream has been drained and ended."""
+
+
+class QueueFull(RuntimeError):
+    """Backpressure deadline expired while the ingress queue stayed full."""
+
+
+class _Pending:
+    __slots__ = ("kind", "pubkeys", "messages", "signature", "key",
+                 "bucket", "future", "t_submit")
+
+    def __init__(self, kind, pubkeys, messages, signature, key, bucket,
+                 future, t_submit):
+        self.kind = kind
+        self.pubkeys = pubkeys
+        self.messages = messages
+        self.signature = signature
+        self.key = key
+        self.bucket = bucket
+        self.future = future
+        self.t_submit = t_submit
+
+
+class _CapturedOracle:
+    """The pure-Python per-item fallback, captured eagerly (see module
+    NOTE: looking the switchboard up lazily could resolve to a collector's
+    interceptor)."""
+
+    def __init__(self, fast_aggregate_verify, aggregate_verify):
+        self.fast_aggregate_verify = fast_aggregate_verify
+        self.aggregate_verify = aggregate_verify
+
+    def verify_one(self, p: _Pending) -> bool:
+        if p.kind == "fast_aggregate":
+            return bool(self.fast_aggregate_verify(p.pubkeys, p.messages,
+                                                   p.signature))
+        return bool(self.aggregate_verify(p.pubkeys, p.messages, p.signature))
+
+
+class VerificationService:
+    """Streaming front of the batched BLS backend.
+
+    ``submit(kind, pubkeys, messages, signature) -> Future[bool]``; see
+    the module docstring for the dataflow. Use as a context manager, or
+    call ``close()`` — close drains: every accepted request resolves.
+    """
+
+    def __init__(self, backend=None, oracle=None, *, max_batch: int = 256,
+                 max_wait_ms: float = 20.0, max_queue: int = 4096,
+                 cache_capacity: int = 1 << 16, backend_retries: int = 1,
+                 bucket_fn=None):
+        assert max_batch > 0 and max_queue > 0
+        self._backend = backend  # None: resolved lazily on first batch
+        if oracle is None:
+            from ..utils import bls
+
+            oracle = _CapturedOracle(bls.FastAggregateVerify,
+                                     bls.AggregateVerify)
+        self._oracle = oracle
+        if bucket_fn is None:
+            from ..ops.bls_backend import _k_bucket as bucket_fn
+        self._bucket_fn = bucket_fn
+        self._max_batch = max_batch
+        self._max_wait_s = max_wait_ms / 1e3
+        self._max_queue = max_queue
+        self._backend_retries = max(0, backend_retries)
+
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)      # queue gained items / closing
+        self._not_full = threading.Condition(self._lock)  # queue lost items
+        self._queue: "deque[_Pending]" = deque()
+        self._inflight = {}  # key -> _Pending (queued or mid-batch)
+        self._cache = ResultCache(cache_capacity)
+        self.metrics = ServeMetrics()
+        self._closed = False
+        self._worker = threading.Thread(
+            target=self._run, name="verification-service", daemon=True
+        )
+        self._worker.start()
+
+    # -- ingress ------------------------------------------------------------
+
+    def submit(self, kind: str, pubkeys, messages, signature,
+               timeout: Optional[float] = None) -> "Future[bool]":
+        """Enqueue one verification; returns a Future resolving to bool.
+
+        The reference's no-crypto rules are answered eagerly, exactly as
+        the switchboard would (reference utils/bls.py:47-74): empty pubkey
+        sets and pubkey/message length mismatches are False; stub mode
+        (``bls_active`` off) is True. Everything else is batched.
+
+        Backpressure: when the ingress queue is full, submit blocks until
+        space frees (bounded by ``timeout`` seconds -> QueueFull).
+        """
+        from ..utils import bls
+
+        t0 = time.perf_counter()
+        if kind not in KINDS:
+            raise ValueError(f"unknown check kind {kind!r}")
+        self.metrics.note_submit()
+        fut: "Future[bool]" = Future()
+        if not bls.bls_active:
+            self.metrics.note_eager()
+            fut.set_result(True)
+            return fut
+        pubkeys = [bytes(pk) for pk in pubkeys]
+        signature = bytes(signature)
+        if kind == "fast_aggregate":
+            messages = bytes(messages)
+            if len(pubkeys) == 0:
+                self.metrics.note_eager()
+                fut.set_result(False)
+                return fut
+        else:
+            messages = [bytes(m) for m in messages]
+            if len(pubkeys) == 0 or len(pubkeys) != len(messages):
+                self.metrics.note_eager()
+                fut.set_result(False)
+                return fut
+        key = check_key(kind, pubkeys, messages, signature)
+
+        with self._lock:
+            deadline = None if timeout is None else t0 + timeout
+            # dedup and space checks live in ONE loop: a backpressure wait
+            # releases the lock, so identical content may complete (cache)
+            # or enqueue (in-flight) while we block — re-checking after
+            # every wakeup keeps the verified-exactly-once invariant
+            while True:
+                if self._closed:
+                    raise ServiceClosed(
+                        "submit() on a closed VerificationService"
+                    )
+                hit = self._cache.get(key)
+                if hit is not None:
+                    self.metrics.note_cache_hit()
+                    self.metrics.note_result(time.perf_counter() - t0)
+                    fut.set_result(hit)
+                    return fut
+                pend = self._inflight.get(key)
+                if pend is not None:
+                    # same content already queued/verifying: share its Future
+                    self.metrics.note_inflight_join()
+                    return pend.future
+                if len(self._queue) < self._max_queue:
+                    break
+                remaining = (None if deadline is None
+                             else deadline - time.perf_counter())
+                if remaining is not None and remaining <= 0:
+                    raise QueueFull(
+                        f"ingress queue held {self._max_queue} requests for "
+                        f"{timeout}s"
+                    )
+                self._not_full.wait(remaining)
+            pend = _Pending(kind, pubkeys, messages, signature, key,
+                            self._bucket_fn(max(1, len(pubkeys))), fut, t0)
+            self._queue.append(pend)
+            self._inflight[key] = pend
+            self.metrics.note_enqueued(len(self._queue))
+            self._work.notify()
+        return fut
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def close(self, timeout: Optional[float] = None) -> None:
+        """Stop accepting submissions and drain: blocks until the worker
+        has resolved every accepted request and exited."""
+        with self._lock:
+            self._closed = True
+            self._work.notify_all()
+            self._not_full.notify_all()
+        self._worker.join(timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    @property
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    @property
+    def cache(self) -> ResultCache:
+        return self._cache
+
+    # -- worker -------------------------------------------------------------
+
+    def _resolve_backend(self):
+        if self._backend is None:
+            from ..ops import bls_backend
+
+            self._backend = bls_backend
+        return self._backend
+
+    def _run(self):
+        while True:
+            batch = self._collect()
+            if batch is None:
+                return
+            try:
+                self._process(batch)
+            except Exception:
+                # belt-and-braces: _process guards each group; whatever
+                # still leaks must not kill the stream — resolve the
+                # batch through the oracle, item by item
+                self._resolve_sequential(
+                    [p for p in batch if not p.future.done()]
+                )
+
+    def _collect(self) -> Optional[List[_Pending]]:
+        """Block for work, then gather one batch: flush when ``max_batch``
+        requests are waiting OR ``max_wait_ms`` has passed since the
+        OLDEST waiting request was submitted, whichever comes first.
+        Returns None when closed and fully drained."""
+        with self._lock:
+            while not self._queue:
+                if self._closed:
+                    return None
+                self._work.wait()
+            deadline = self._queue[0].t_submit + self._max_wait_s
+            while len(self._queue) < self._max_batch and not self._closed:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                self._work.wait(remaining)
+            n = min(self._max_batch, len(self._queue))
+            batch = [self._queue.popleft() for _ in range(n)]
+            profiling.set_gauge("serve.queue_depth", len(self._queue))
+            self._not_full.notify_all()
+            return batch
+
+    def _process(self, batch: List[_Pending]) -> None:
+        groups = {}
+        for p in batch:
+            groups.setdefault((p.kind, p.bucket), []).append(p)
+        for (kind, bucket), pends in groups.items():
+            t0 = time.perf_counter()
+            results = self._verify_group(kind, pends)
+            self.metrics.note_batch(
+                len(pends), sum(len(p.pubkeys) for p in pends), bucket,
+                time.perf_counter() - t0,
+            )
+            self._settle(pends, results)
+        self.metrics.export_gauges()
+
+    def _verify_group(self, kind: str, pends: List[_Pending]) -> List[bool]:
+        backend = self._resolve_backend()
+        last_err = None
+        for attempt in range(1 + self._backend_retries):
+            if attempt:
+                self.metrics.note_retry()
+            try:
+                if kind == "fast_aggregate":
+                    res = backend.batch_fast_aggregate_verify(
+                        [p.pubkeys for p in pends],
+                        [p.messages for p in pends],
+                        [p.signature for p in pends],
+                    )
+                else:
+                    res = backend.batch_aggregate_verify(
+                        [p.pubkeys for p in pends],
+                        [p.messages for p in pends],
+                        [p.signature for p in pends],
+                    )
+                return [bool(r) for r in res]
+            except Exception as e:  # device/compile/transfer failure
+                last_err = e
+        # poisoned batch: degrade to sequential oracle verification —
+        # the stream slows down, it does not fail
+        profiling.record("serve.backend_error", 0.0)
+        del last_err
+        self.metrics.note_fallback(len(pends))
+        return [self._oracle_one(p) for p in pends]
+
+    def _oracle_one(self, p: _Pending) -> bool:
+        try:
+            return self._oracle.verify_one(p)
+        except Exception:
+            return False  # the switchboard's exception-swallowing contract
+
+    def _resolve_sequential(self, pends: List[_Pending]) -> None:
+        self.metrics.note_fallback(len(pends))
+        self._settle(pends, [self._oracle_one(p) for p in pends])
+
+    def _settle(self, pends: List[_Pending], results: List[bool]) -> None:
+        now = time.perf_counter()
+        with self._lock:
+            for p, r in zip(pends, results):
+                self._cache.put(p.key, bool(r))
+                self._inflight.pop(p.key, None)
+        for p, r in zip(pends, results):
+            self.metrics.note_result(now - p.t_submit)
+            if not p.future.done():
+                p.future.set_result(bool(r))

@@ -38,6 +38,25 @@ def test_flush_matches_oracle_small():
     assert list(want) == [True, True, False]
 
 
+def test_flush_dedups_identical_checks():
+    """The same attestation included in multiple blocks is ONE backend
+    verification, fanned out to every occurrence — equivalent to the
+    per-occurrence oracle."""
+    from consensus_specs_tpu.ops import bls_backend
+
+    col = SignatureCollector()
+    _mk_check(col, 2, b"d1" + b"\x00" * 30)
+    _mk_check(col, 2, b"d1" + b"\x00" * 30)  # identical record
+    _mk_check(col, 2, b"d2" + b"\x00" * 30, corrupt=True)
+    _mk_check(col, 2, b"d2" + b"\x00" * 30, corrupt=True)  # identical again
+    bls_backend.reset_call_counts()
+    got = col.flush()
+    assert bls_backend.CALL_COUNTS["items"] == 2  # 4 records, 2 uniques
+    want = col.flush_oracle()
+    assert np.array_equal(got, want)
+    assert list(want) == [True, True, False, False]
+
+
 @pytest.mark.slow
 def test_epoch_replay_batched_matches_sequential():
     """Replay two slots of real blocks-with-attestations twice: once with
@@ -157,6 +176,56 @@ def test_fork_choice_attestations_batched():
     ok = feed_attestations_batched(spec, store, attestations)
     assert len(ok) == len(attestations) and ok.all()
     # every attester's LMD vote landed, exactly as sequential feeding would
+    voters = set()
+    for a in attestations:
+        voters |= set(spec.get_attesting_indices(state, a.data, a.aggregation_bits))
+    assert set(store.latest_messages) == voters
+
+
+@pytest.mark.slow
+def test_fork_choice_attestations_streamed_matches_batched():
+    """feed_attestations_streamed (the serve-plane twin): identical store
+    effects and verdicts, duplicate gossip copies verified once."""
+    from consensus_specs_tpu.batch_verify import feed_attestations_streamed
+    from consensus_specs_tpu.ops import bls_backend
+    from consensus_specs_tpu.serve import VerificationService
+    from consensus_specs_tpu.test.context import build_spec_module
+    from consensus_specs_tpu.test.helpers.attestations import get_valid_attestation
+    from consensus_specs_tpu.test.helpers.block import build_empty_block_for_next_slot
+    from consensus_specs_tpu.test.helpers.fork_choice import (
+        get_genesis_forkchoice_store, slot_time,
+    )
+    from consensus_specs_tpu.test.helpers.genesis import create_genesis_state
+    from consensus_specs_tpu.test.helpers.state import state_transition_and_sign_block
+
+    spec = build_spec_module("phase0", "minimal")
+    bls.bls_active = True
+    state = create_genesis_state(
+        spec, [spec.MAX_EFFECTIVE_BALANCE] * 64, spec.MAX_EFFECTIVE_BALANCE
+    )
+    store = get_genesis_forkchoice_store(spec, state)
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    spec.on_tick(store, slot_time(spec, store, block.slot + 1))
+    spec.on_block(store, signed_block)
+
+    attestations = [
+        get_valid_attestation(spec, state, slot=block.slot, index=i, signed=True)
+        for i in range(int(spec.get_committee_count_per_slot(
+            state, spec.get_current_epoch(state)
+        )))
+    ]
+    # gossip duplication: every attestation arrives twice (two peers)
+    stream = attestations + attestations
+    bls_backend.reset_call_counts()
+    svc = VerificationService()
+    try:
+        ok = feed_attestations_streamed(spec, store, iter(stream), service=svc)
+    finally:
+        svc.close(timeout=60)
+    assert len(ok) == len(stream) and ok.all()
+    # each distinct aggregate hit the backend once despite two copies
+    assert bls_backend.CALL_COUNTS["items"] == len(attestations)
     voters = set()
     for a in attestations:
         voters |= set(spec.get_attesting_indices(state, a.data, a.aggregation_bits))

@@ -1,0 +1,413 @@
+"""Serve plane (consensus_specs_tpu/serve/): flush triggers, cache/dedup
+semantics, oracle fallback on backend failure, and the randomized stream
+equivalence gate (service results == SignatureCollector.flush_oracle()).
+
+The plumbing tests run against a crypto-free counting backend so tier-1
+stays fast; the oracle-delegating backend ties the 200-request stream
+equivalence to real pure-Python crypto on the ~dozen UNIQUE items only
+(duplicates must never reach the backend — that is the assertion); and one
+small real-device-backend test reuses the exact shapes
+tests/test_bls_backend_fast.py already compiles on every default run.
+"""
+import random
+import time
+
+import numpy as np
+import pytest
+
+from consensus_specs_tpu.batch_verify import SignatureCollector
+from consensus_specs_tpu.serve import (
+    QueueFull,
+    ResultCache,
+    ServiceClosed,
+    VerificationService,
+    check_key,
+)
+from consensus_specs_tpu.utils import bls
+
+PK = b"\x01" * 48  # plumbing tests never decode keys; any bytes serve
+
+
+@pytest.fixture(autouse=True)
+def _bls_on():
+    from consensus_specs_tpu.ops import profiling
+
+    profiling.reset()  # latency reservoirs/gauges are process-global
+    was = bls.bls_active
+    bls.bls_active = True
+    yield
+    bls.bls_active = was
+
+
+class CountingBackend:
+    """Crypto-free batched backend: an item verifies True iff its
+    signature ends with b"ok". Counts entry-point calls and items (the
+    same ledger ops/bls_backend.py CALL_COUNTS keeps for the real one)."""
+
+    def __init__(self, delay_s=0.0, fail_always=False, fail_calls=()):
+        self.calls = 0
+        self.items = 0
+        self.delay_s = delay_s
+        self.fail_always = fail_always
+        self.fail_calls = set(fail_calls)
+
+    def _go(self, signatures):
+        self.calls += 1
+        if self.fail_always or self.calls in self.fail_calls:
+            raise RuntimeError(f"injected backend failure (call {self.calls})")
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        self.items += len(signatures)
+        return np.array([s.endswith(b"ok") for s in signatures], dtype=bool)
+
+    def batch_fast_aggregate_verify(self, pubkey_sets, messages, signatures,
+                                    mesh=None):
+        return self._go(signatures)
+
+    def batch_aggregate_verify(self, pubkey_lists, message_lists, signatures,
+                               mesh=None):
+        return self._go(signatures)
+
+
+class OracleBackend(CountingBackend):
+    """Batched entry points that resolve each item through the pure-Python
+    oracle — real crypto, item-at-a-time, with the call ledger. Lets the
+    stream equivalence test exercise real verification on unique items
+    without paying device compiles in tier-1."""
+
+    def _go(self, signatures):
+        raise NotImplementedError
+
+    def batch_fast_aggregate_verify(self, pubkey_sets, messages, signatures,
+                                    mesh=None):
+        self.calls += 1
+        self.items += len(signatures)
+        return np.array(
+            [bls.FastAggregateVerify(pks, m, s)
+             for pks, m, s in zip(pubkey_sets, messages, signatures)],
+            dtype=bool,
+        )
+
+    def batch_aggregate_verify(self, pubkey_lists, message_lists, signatures,
+                               mesh=None):
+        self.calls += 1
+        self.items += len(signatures)
+        return np.array(
+            [bls.AggregateVerify(pks, ms, s)
+             for pks, ms, s in zip(pubkey_lists, message_lists, signatures)],
+            dtype=bool,
+        )
+
+
+class CountingOracle:
+    """verify_one fallback with the signature-suffix truth rule."""
+
+    def __init__(self):
+        self.calls = 0
+
+    def verify_one(self, pending):
+        self.calls += 1
+        return bytes(pending.signature).endswith(b"ok")
+
+
+def _svc(backend, **kw):
+    kw.setdefault("bucket_fn", lambda k: 8)
+    kw.setdefault("oracle", CountingOracle())
+    return VerificationService(backend=backend, **kw)
+
+
+# -- flush triggers ---------------------------------------------------------
+
+
+def test_size_triggered_flush():
+    be = CountingBackend()
+    with _svc(be, max_batch=4, max_wait_ms=10_000) as svc:
+        futs = [
+            svc.submit("fast_aggregate", [PK], b"m%d" % i, b"s%d-ok" % i)
+            for i in range(4)
+        ]
+        # max_wait is 10 s: only the size trigger can resolve these quickly
+        assert [f.result(timeout=5) for f in futs] == [True] * 4
+    assert be.calls == 1 and be.items == 4
+    assert svc.metrics.batches == 1 and svc.metrics.rows_filled == 4
+
+
+def test_deadline_triggered_flush():
+    be = CountingBackend()
+    with _svc(be, max_batch=1000, max_wait_ms=30) as svc:
+        f1 = svc.submit("fast_aggregate", [PK], b"m1", b"a-ok")
+        f2 = svc.submit("fast_aggregate", [PK], b"m2", b"b-bad")
+        # far below max_batch: only the deadline trigger can flush
+        assert f1.result(timeout=5) is True
+        assert f2.result(timeout=5) is False
+    assert svc.metrics.batches >= 1 and svc.metrics.rows_filled == 2
+
+
+def test_shutdown_drain_resolves_everything():
+    be = CountingBackend()
+    svc = _svc(be, max_batch=1000, max_wait_ms=600_000)
+    futs = [
+        svc.submit("fast_aggregate", [PK], b"m%d" % i, b"s%d-ok" % i)
+        for i in range(5)
+    ]
+    svc.close(timeout=30)  # neither trigger fired — close must drain
+    assert all(f.done() for f in futs)
+    assert [f.result() for f in futs] == [True] * 5
+    assert be.items == 5
+
+
+def test_submit_after_close_raises():
+    svc = _svc(CountingBackend())
+    svc.close(timeout=30)
+    with pytest.raises(ServiceClosed):
+        svc.submit("fast_aggregate", [PK], b"m", b"s-ok")
+
+
+# -- cache + dedup ----------------------------------------------------------
+
+
+def test_inflight_join_and_cache_hit_verify_once():
+    be = CountingBackend(delay_s=0.2)
+    with _svc(be, max_batch=1, max_wait_ms=0) as svc:
+        f1 = svc.submit("fast_aggregate", [PK], b"dup", b"sig-ok")
+        # worker is sleeping inside the backend: identical content joins
+        # the in-flight future instead of re-entering the queue
+        f2 = svc.submit("fast_aggregate", [PK], b"dup", b"sig-ok")
+        assert f2 is f1
+        assert f1.result(timeout=10) is True
+        # completed now: a third identical submit is a result-cache hit
+        f3 = svc.submit("fast_aggregate", [PK], b"dup", b"sig-ok")
+        assert f3.done() and f3.result() is True
+    assert be.items == 1  # the duplicate content hit the backend ONCE
+    assert svc.metrics.inflight_joins == 1
+    assert svc.metrics.cache_hits == 1
+    assert svc.metrics.hit_rate > 0
+
+
+def test_result_cache_lru_and_key_framing():
+    c = ResultCache(capacity=2)
+    ka = check_key("fast_aggregate", [b"pk1"], b"m", b"s")
+    kb = check_key("fast_aggregate", [b"pk2"], b"m", b"s")
+    kc = check_key("fast_aggregate", [b"pk3"], b"m", b"s")
+    c.put(ka, True)
+    c.put(kb, False)
+    assert c.get(ka) is True  # refreshes ka
+    c.put(kc, True)  # evicts kb (LRU), not ka
+    assert c.get(kb) is None and c.get(ka) is True and c.get(kc) is True
+    assert len(c) == 2 and c.hits == 3 and c.misses == 1
+
+    # length framing: a different pubkey split must never alias
+    assert (check_key("fast_aggregate", [b"ab", b"c"], b"m", b"s")
+            != check_key("fast_aggregate", [b"a", b"bc"], b"m", b"s"))
+    # kind and message-shape tags must never alias either
+    assert (check_key("fast_aggregate", [b"pk"], b"m", b"s")
+            != check_key("aggregate", [b"pk"], [b"m"], b"s"))
+
+
+# -- eager reference rules --------------------------------------------------
+
+
+def test_reference_rules_answered_eagerly():
+    be = CountingBackend()
+    with _svc(be) as svc:
+        assert svc.submit("fast_aggregate", [], b"m", b"s").result() is False
+        assert svc.submit("aggregate", [PK], [], b"s").result() is False
+        assert svc.submit("aggregate", [PK], [b"a", b"b"], b"s").result() is False
+        bls.bls_active = False
+        try:
+            assert svc.submit("fast_aggregate", [PK], b"m", b"s-bad").result() is True
+        finally:
+            bls.bls_active = True
+        with pytest.raises(ValueError):
+            svc.submit("proposer", [PK], b"m", b"s")
+    assert be.calls == 0  # nothing above may reach the backend
+
+
+# -- failure handling -------------------------------------------------------
+
+
+def test_backend_failure_degrades_to_oracle():
+    be = CountingBackend(fail_always=True)
+    orc = CountingOracle()
+    with _svc(be, oracle=orc, max_batch=4, max_wait_ms=10_000,
+              backend_retries=1) as svc:
+        futs = [
+            svc.submit("fast_aggregate", [PK], b"m%d" % i,
+                       b"s%d-ok" % i if i % 2 == 0 else b"s%d-bad" % i)
+            for i in range(4)
+        ]
+        got = [f.result(timeout=10) for f in futs]
+    assert got == [True, False, True, False]  # correct, not lost/corrupted
+    assert be.calls == 2  # first attempt + one bounded retry, then oracle
+    assert orc.calls == 4
+    assert svc.metrics.fallback_items == 4
+    assert svc.metrics.backend_retries == 1
+
+
+def test_transient_failure_recovers_on_retry():
+    be = CountingBackend(fail_calls=(1,))
+    with _svc(be, max_batch=2, max_wait_ms=10_000, backend_retries=1) as svc:
+        f1 = svc.submit("fast_aggregate", [PK], b"m1", b"a-ok")
+        f2 = svc.submit("fast_aggregate", [PK], b"m2", b"b-ok")
+        assert f1.result(timeout=10) is True and f2.result(timeout=10) is True
+    assert be.calls == 2 and be.items == 2  # retry carried the batch
+    assert svc.metrics.fallback_items == 0
+
+
+def test_backpressure_queue_full():
+    be = CountingBackend(delay_s=0.5)
+    svc = _svc(be, max_batch=1, max_wait_ms=0, max_queue=1)
+    try:
+        f1 = svc.submit("fast_aggregate", [PK], b"m1", b"a-ok")
+        time.sleep(0.1)  # worker takes m1 and sleeps inside the backend
+        f2 = svc.submit("fast_aggregate", [PK], b"m2", b"b-ok")
+        with pytest.raises(QueueFull):
+            svc.submit("fast_aggregate", [PK], b"m3", b"c-ok", timeout=0.05)
+        assert f1.result(timeout=10) is True
+        assert f2.result(timeout=10) is True
+    finally:
+        svc.close(timeout=30)
+
+
+# -- randomized stream equivalence (acceptance gate) ------------------------
+
+
+def _build_pool():
+    """Distinct verifiable content: both kinds, mixed K buckets, a share
+    of corrupt items (wrong message / wrong signature -> False)."""
+    from consensus_specs_tpu.utils.bls12_381 import R
+
+    pool = []
+    for i, k in enumerate([1, 2, 3, 5, 1, 2, 8, 3]):
+        sks = [100 * (i + 1) + j + 1 for j in range(k)]
+        pks = [bls.SkToPk(sk) for sk in sks]
+        msg = (b"fa%02d" % i) + b"\x00" * 28
+        # aggregate of same-message sigs == one sig by the summed key
+        sig = bls.Sign(sum(sks) % R, msg)
+        if i % 4 == 3:
+            msg = b"\xff" + msg[1:]  # corrupt: must verify False
+        pool.append(("fast_aggregate", pks, msg, sig))
+    for i, k in enumerate([1, 2, 3]):
+        sks = [1000 + 10 * i + j + 1 for j in range(k)]
+        pks = [bls.SkToPk(sk) for sk in sks]
+        msgs = [(b"ag%02d_%d" % (i, j)) + b"\x00" * 24 for j in range(k)]
+        sig = bls.Aggregate([bls.Sign(sk, m) for sk, m in zip(sks, msgs)])
+        if i == 2:
+            sig = bls.Sign(999, b"z" * 32)  # unrelated signature: False
+        pool.append(("aggregate", pks, msgs, sig))
+    return pool
+
+
+def test_randomized_stream_equivalence_vs_oracle():
+    """>= 200 mixed submit()s (both kinds, mixed K buckets, duplicates
+    injected): service results must be bit-identical to the collector's
+    flush_oracle() on the same stream, every duplicate verified exactly
+    once (backend item ledger == unique count), cache hit rate > 0."""
+    from consensus_specs_tpu.ops.bls_backend import _k_bucket
+
+    rng = random.Random(0xC0FFEE)
+    pool = _build_pool()
+    events = [pool[rng.randrange(len(pool))] for _ in range(200)]
+    events[: len(pool)] = pool  # every distinct item appears at least once
+
+    # sequential reference: the same stream recorded through the collector
+    # and resolved by flush_oracle() (per-occurrence pure-Python verify)
+    col = SignatureCollector()
+    for kind, pks, msgs, sig in events:
+        if kind == "fast_aggregate":
+            assert col._fast_aggregate_verify(pks, msgs, sig) is True
+        else:
+            assert col._aggregate_verify(pks, msgs, sig) is True
+    uniq, members = col._unique_checks()
+    assert len(uniq) == len(pool)
+    # flush_oracle on the unique slice, fanned out in record order — the
+    # oracle verdict per occurrence without 200 redundant pairings
+    ucol = SignatureCollector()
+    ucol.checks = [col.checks[i] for i in uniq]
+    want_unique = ucol.flush_oracle()
+    want = np.zeros(len(events), dtype=bool)
+    for u, m in enumerate(members):
+        want[m] = want_unique[u]
+
+    be = OracleBackend()
+    svc = VerificationService(backend=be, bucket_fn=_k_bucket,
+                              max_batch=32, max_wait_ms=5)
+    try:
+        futs = [svc.submit(kind, pks, msgs, sig)
+                for kind, pks, msgs, sig in events]
+        got = np.array([f.result(timeout=120) for f in futs], dtype=bool)
+    finally:
+        svc.close(timeout=60)
+
+    assert np.array_equal(got, want)
+    assert want.any() and not want.all()  # stream carried Trues AND Falses
+    # every duplicate verified exactly once: the backend saw each distinct
+    # item one time, and dedup absorbed everything else
+    assert be.items == len(pool)
+    m = svc.metrics
+    assert m.cache_hits + m.inflight_joins == len(events) - len(pool)
+    assert m.hit_rate > 0
+    snap = m.snapshot()
+    # joins share the first submitter's Future and therefore its latency
+    # sample; everyone else (enqueued + cache hits) records one
+    assert snap["latency"]["count"] == len(events) - m.inflight_joins
+    assert 0 < snap["occupancy_rows"] <= 1
+
+
+def test_service_with_real_device_backend():
+    """The service in front of the REAL batched backend, at the exact
+    shapes tests/test_bls_backend_fast.py compiles on every default run
+    (bucket 2, two rows) — ties the serve plane to the device path in
+    tier-1 without new compile cost."""
+    sk1, sk2 = 41, 42
+    pk1, pk2 = bls.SkToPk(sk1), bls.SkToPk(sk2)
+    msg = b"\x05" * 32
+    agg = bls.Aggregate([bls.Sign(sk1, msg), bls.Sign(sk2, msg)])
+
+    from consensus_specs_tpu.ops import bls_backend
+
+    bls_backend.reset_call_counts()
+    svc = VerificationService(max_batch=2, max_wait_ms=10_000)
+    try:
+        f_good = svc.submit("fast_aggregate", [pk1, pk2], msg, agg)
+        # same K bucket (2) so both ride ONE grouped backend call; the
+        # doubled pk1 aggregates to the wrong key -> False
+        f_bad = svc.submit("fast_aggregate", [pk1, pk1], msg, agg)
+        assert f_good.result(timeout=300) is True
+        assert f_bad.result(timeout=300) is False
+        # duplicate of a completed item: cache, not crypto
+        assert svc.submit("fast_aggregate", [pk1, pk2], msg, agg).result() is True
+    finally:
+        svc.close(timeout=60)
+    assert bls_backend.CALL_COUNTS["batch_fast_aggregate_verify"] == 1
+    assert bls_backend.CALL_COUNTS["items"] == 2
+    assert svc.metrics.fallback_items == 0
+
+
+# -- collector integration --------------------------------------------------
+
+
+def test_collector_flush_routes_through_service():
+    """SignatureCollector.flush(service=...) returns the same verdicts in
+    record order as flush_oracle(), with duplicates fanned out."""
+    from consensus_specs_tpu.utils.bls12_381 import R
+
+    sks = [11, 12]
+    pks = [bls.SkToPk(sk) for sk in sks]
+    msg = b"flush-via-service" + b"\x00" * 15
+    sig = bls.Sign(sum(sks) % R, msg)
+
+    col = SignatureCollector()
+    assert col._fast_aggregate_verify(pks, msg, sig) is True
+    assert col._fast_aggregate_verify(pks, msg, sig) is True  # duplicate
+    assert col._fast_aggregate_verify(pks, b"\xff" + msg[1:], sig) is True
+
+    be = OracleBackend()
+    svc = VerificationService(backend=be, max_batch=8, max_wait_ms=5)
+    try:
+        got = col.flush(service=svc)
+    finally:
+        svc.close(timeout=60)
+    assert np.array_equal(got, col.flush_oracle())
+    assert list(got) == [True, True, False]
+    assert be.items == 2  # duplicate collapsed before submission
