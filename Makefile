@@ -17,7 +17,7 @@ HEAVY_GENERATORS = operations sanity epoch_processing rewards finality forks tra
 CHEAP_GENERATORS = shuffling bls ssz_generic merkle
 
 .PHONY: test citest test_tpu_backend lint generate_tests \
-        detect_generator_incomplete check_vectors bench serve-bench multichip \
+        detect_generator_incomplete check_vectors bench serve-bench codec-bench multichip \
         clean_vectors generate_random_tests
 
 # fast default: BLS stubbed except @always_bls, 4-way process-parallel
@@ -86,9 +86,17 @@ bench:
 # synthetic gossip load — Poisson arrivals, duplicate-heavy traffic, one
 # injected backend failure — through the continuous-batching
 # VerificationService; emits one JSON line with sustained signatures/sec,
-# batch occupancy, cache hit rate, and p50/p95/p99 submit->result latency
+# batch occupancy, cache hit rate, p50/p95/p99 submit->result latency,
+# and the prep-vs-device time split of the two-stage pipeline
 serve-bench:
 	JAX_PLATFORMS=cpu python bench.py --mode serve
+
+# prep-only microbenchmark: the batched input codec (ops/codec.py —
+# decompression, subgroup checks, hash-to-G2) vs the per-item pure-Python
+# prep path, items/sec on a CPU-sized batch (CODEC_ITEMS, default 64);
+# the JSON line's vs_baseline field is the batched-over-per-item speedup
+codec-bench:
+	JAX_PLATFORMS=cpu python bench.py --mode codec
 
 multichip:
 	python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('multichip OK')"

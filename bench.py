@@ -44,8 +44,12 @@ synthetic Poisson gossip load (duplicate-heavy, with an injected backend
 failure) through the streaming VerificationService
 (consensus_specs_tpu/serve/) in-process on CPU, and its JSON line carries
 sustained signatures/sec plus the serving numbers — batch occupancy, cache
-hit rate, p50/p95/p99 submit->result latency (knobs: SERVE_* env vars, see
-serve/load.py).
+hit rate, p50/p95/p99 submit->result latency, and the prep-vs-device time
+split per flush (knobs: SERVE_* env vars, see serve/load.py).
+
+`--mode codec` is the prep-only microbenchmark: the batched input codec
+(ops/codec.py) vs the per-item pure-Python prep path, items/sec over
+CODEC_ITEMS items per kind — no pairings, just the front-door cost.
 """
 import json
 import os
@@ -335,6 +339,19 @@ def main():
         from consensus_specs_tpu.serve.load import run_serve_bench
 
         _emit_result(run_serve_bench())
+        return
+
+    if _cli_mode() == "codec":
+        # prep-only microbench: batched input codec vs per-item host prep
+        # (decode + subgroup + hash-to-G2, no pairings). CPU-forced — the
+        # acceptance bar is the codec's host fallback beating the
+        # per-item path on plain CPU; CODEC_ITEMS sizes the batch
+        from consensus_specs_tpu.utils.jax_env import force_cpu
+
+        force_cpu()
+        from consensus_specs_tpu.bench.codec_prep import run_codec_bench
+
+        _emit_result(run_codec_bench())
         return
 
     if os.environ.get(_CHILD_FLAG) == "1":

@@ -1,0 +1,104 @@
+"""Prep-only microbenchmark: batched input codec vs per-item host prep.
+
+Measures exactly the front-door cost the codec plane (ops/codec.py) was
+built to kill: decode+KeyValidate of N pubkeys, decode+subgroup-check of
+N signatures, and hash-to-G2 of N messages — once through the per-item
+pure-Python compute functions (`ops/bls_backend._*_limbs_compute`, the
+cache-miss fallback) and once through the batched codec entry points
+(`codec.pubkey_limbs_batch` / `signature_limbs_batch` /
+`message_limbs_batch`). No pairing work on either side: this isolates the
+codec win that `bench.py --mode serve` reports as prep_ms_per_flush.
+
+Setup (constructing N valid points via oracle scalar multiplies) is
+excluded from the timed regions. Knobs: CODEC_ITEMS (default 64),
+CODEC_SEED. Run via `make codec-bench` (CPU-forced, so the codec's
+raw-int host fallback is what gets measured — the acceptance bar is
+beating the per-item path at >= 64-item batches on plain CPU).
+"""
+import os
+import time
+from typing import Dict, List
+
+
+def _build_inputs(n: int, seed: int):
+    """N distinct pubkeys / signatures / messages (one scalar multiply
+    each — setup stays linear and outside the timed window)."""
+    import hashlib
+
+    from ..utils import bls12_381 as O
+
+    pks: List[bytes] = []
+    sigs: List[bytes] = []
+    msgs: List[bytes] = []
+    for i in range(n):
+        k = (
+            int.from_bytes(
+                hashlib.sha256(b"codec-bench%d:%d" % (seed, i)).digest(),
+                "big",
+            )
+            % O.R
+        ) or 1
+        pks.append(O.g1_to_bytes(O.ec_mul(O.G1_GEN, k)))
+        sigs.append(O.g2_to_bytes(O.ec_mul(O.G2_GEN, k)))
+        msgs.append(hashlib.sha256(b"codec-msg%d:%d" % (seed, i)).digest())
+    return pks, sigs, msgs
+
+
+def run_codec_bench() -> dict:
+    """Returns bench.py's result dict; value is batched-codec items/sec
+    over all three kinds, vs_baseline is the speedup over the per-item
+    path (>1 means the codec wins)."""
+    from ..ops import bls_backend, codec
+
+    n = int(os.environ.get("CODEC_ITEMS", "64"))
+    seed = int(os.environ.get("CODEC_SEED", "7"))
+    pks, sigs, msgs = _build_inputs(n, seed)
+
+    # per-item path (the cache-miss fallback the codec replaces)
+    per_item: Dict[str, float] = {}
+    t0 = time.perf_counter()
+    for pk in pks:
+        bls_backend._pubkey_limbs_compute(pk)
+    per_item["pk"] = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for s in sigs:
+        bls_backend._signature_limbs_compute(s)
+    per_item["sig"] = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for m in msgs:
+        bls_backend._message_limbs_compute(m)
+    per_item["msg"] = time.perf_counter() - t0
+
+    batched: Dict[str, float] = {}
+    t0 = time.perf_counter()
+    codec.pubkey_limbs_batch(pks)
+    batched["pk"] = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    codec.signature_limbs_batch(sigs)
+    batched["sig"] = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    codec.message_limbs_batch(msgs, bls_backend.DST)
+    batched["msg"] = time.perf_counter() - t0
+
+    total_items = 3 * n
+    per_item_s = sum(per_item.values())
+    batched_s = sum(batched.values())
+    speedup = per_item_s / batched_s if batched_s else 0.0
+    return dict(
+        metric="codec prep items/sec (batched input codec, all kinds)",
+        value=total_items / batched_s if batched_s else 0.0,
+        vs_baseline=round(speedup, 4),  # here: speedup over per-item prep
+        mode="codec",
+        items_per_kind=n,
+        device_path=codec._use_device(),
+        per_item_items_per_sec=round(
+            total_items / per_item_s if per_item_s else 0.0, 2
+        ),
+        speedup=round(speedup, 4),
+        per_kind_speedup={
+            k: round(per_item[k] / batched[k], 4) if batched[k] else 0.0
+            for k in per_item
+        },
+        per_item_ms={k: round(1e3 * v, 2) for k, v in per_item.items()},
+        batched_ms={k: round(1e3 * v, 2) for k, v in batched.items()},
+    )

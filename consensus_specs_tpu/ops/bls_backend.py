@@ -10,7 +10,10 @@ Pipeline (see ops/vm.py and ops/vmlib.py for the execution model):
 
   HOST  decode/KeyValidate pubkeys (LRU-cached with their Montgomery limb
         encodings), decode+subgroup-check signatures, hash messages to G2 —
-        exact-int Python, bit-identical to the oracle's rejection rules.
+        prewarmed array-wide by the BATCHED input codec (ops/codec.py:
+        vectorized decompression, VM-program subgroup checks, native-SHA
+        hash-to-G2), bit-identical to the oracle's rejection rules; the
+        per-item exact-int Python path remains the cache-miss fallback.
   PROG A (device) aggregate K projective pubkeys (complete additions; masked
         lanes are infinity) + both Miller loops -> f, agg_Z.
   HOST  easy part of the final exponentiation (one exact Fq12 inversion +
@@ -98,6 +101,12 @@ def _pow2_floor(n: int) -> int:
     return b
 
 
+# codec-plane programs (ops/codec.py): serial complete-addition ladders
+# with little per-item ILP, so folding is the main lane-utilization lever;
+# tables sized so assembly stays a few seconds per variant
+_CODEC_FOLDS = {"g1_subgroup": 4, "g2_subgroup": 8, "h2g_finish": 4}
+
+
 def _fold_for(kind: str, k: int, n_items: int = 1 << 30) -> int:
     """Items folded per program row — enough to saturate the lanes, capped
     so the register file stays modest for wide-committee buckets, and
@@ -105,6 +114,8 @@ def _fold_for(kind: str, k: int, n_items: int = 1 << 30) -> int:
     mostly-filler folded program)."""
     if kind == "hard_part":
         table = 32
+    elif kind in _CODEC_FOLDS:
+        table = _CODEC_FOLDS[kind]
     elif k <= 160:
         table = 8
     elif k <= 256:
@@ -167,6 +178,12 @@ def _program(kind: str, k: int = 0, fold: int = None) -> Tuple[vm.Program, int]:
         prog = vmlib.build_aggregate_verify_miller(k, fold)
     elif kind == "hard_part":
         prog = vmlib.build_hard_part(fold)
+    elif kind == "g1_subgroup":
+        prog = vmlib.build_g1_subgroup_check(fold)
+    elif kind == "g2_subgroup":
+        prog = vmlib.build_g2_subgroup_check(fold)
+    elif kind == "h2g_finish":
+        prog = vmlib.build_h2g_finish(fold)
     else:
         raise ValueError(kind)
     assembled = prog.assemble(
@@ -236,27 +253,39 @@ _CACHE_CAPS = {id(_SIG_CACHE): 1 << 16, id(_MSG_CACHE): 1 << 16,
                id(_PK_CACHE): 1 << 20}
 
 
+def _cache_put(cache: Dict, key: bytes, value) -> None:
+    """Insert with the shared eviction policy: at capacity, drop the
+    least-recently-USED half (hits refresh insertion order below, so dict
+    order IS recency order) — wiping a whole cache would drop every hot
+    validator key at once and cause a multi-second recompute cliff.
+    Removals are tolerant pops: the serve pipeline's prep stage writes
+    these dicts while the device stage reads them."""
+    if len(cache) >= _CACHE_CAPS[id(cache)]:
+        for k in list(cache.keys())[: len(cache) // 2]:
+            cache.pop(k, None)
+    cache[key] = value
+
+
 def _cached(cache: Dict, key: bytes, compute):
     """Shared accessor: compute fns RETURN a ValueError value on validation
     failure (so pool workers can ship it); only successes are cached —
     attacker-supplied invalid inputs can neither occupy slots nor force the
-    eviction wipe — and the result/raise semantics stay uniform."""
+    eviction wipe — and the result/raise semantics stay uniform.
+
+    Concurrency: the serve pipeline's prep stage warms these dicts while
+    the device stage reads them, so every remove is a tolerant pop — a
+    key another thread just refreshed/evicted must not raise here (the
+    worst case is a recompute or a slightly stale recency order, both
+    harmless)."""
     v = cache.get(key)
     if v is None:
         v = compute(key)
         if not isinstance(v, ValueError):
-            if len(cache) >= _CACHE_CAPS[id(cache)]:
-                # rare: that many DISTINCT valid inputs. Evict the
-                # least-recently-USED half (hits below refresh insertion
-                # order, so dict order IS recency order) — wiping the
-                # whole pubkey cache would drop every hot validator key
-                # at once and cause a multi-second recompute cliff
-                for k in list(cache.keys())[: len(cache) // 2]:
-                    del cache[k]
-            cache[key] = v
+            _cache_put(cache, key, v)
     else:
         # refresh recency so prewarmed hot keys outlive per-epoch churn
-        cache[key] = cache.pop(key)
+        cache.pop(key, None)
+        cache[key] = v
     if isinstance(v, ValueError):
         raise v
     return v
@@ -322,21 +351,129 @@ def _prewarm_worker(args):
 
 _POOL_BROKEN = False
 
+# prep-plane observability (ISSUE 2 satellite): which path warmed the
+# caches, how many items silently degraded to serial per-item prep, and
+# whether the pool latch is set — exported as ops/profiling gauges and
+# read by the serve plane's metrics snapshot
+PREP_STATS = {
+    "codec_batches": 0,
+    "codec_items": 0,
+    "pool_batches": 0,
+    "pool_items": 0,
+    "serial_fallback_items": 0,
+    "pool_broken_latches": 0,
+}
+
+
+def _set_pool_broken(flag: bool) -> None:
+    global _POOL_BROKEN
+    _POOL_BROKEN = flag
+    if flag:
+        PREP_STATS["pool_broken_latches"] += 1
+    from . import profiling
+
+    profiling.set_gauge("bls.prep_pool_broken", 1.0 if flag else 0.0)
+
+
+def _note_serial_fallback(n: int) -> None:
+    PREP_STATS["serial_fallback_items"] += n
+    from . import profiling
+
+    profiling.set_gauge(
+        "bls.prep_serial_fallback_items", PREP_STATS["serial_fallback_items"]
+    )
+
+
+def reset_prep_state() -> None:
+    """reset_call_counts()-style recovery hook: clear the pool-broken latch
+    and the prep counters, so a long-lived service can retry the pool after
+    a transient failure instead of latching into serial prep forever."""
+    global _POOL_BROKEN
+    _POOL_BROKEN = False
+    for k in PREP_STATS:
+        PREP_STATS[k] = 0
+    from . import profiling
+
+    profiling.set_gauge("bls.prep_pool_broken", 0.0)
+    profiling.set_gauge("bls.prep_serial_fallback_items", 0.0)
+
+
+def _codec_enabled() -> bool:
+    return os.environ.get("CONSENSUS_SPECS_TPU_BATCH_CODEC", "1") != "0"
+
+
+def _prewarm_batched(msgs, sigs, pks) -> None:
+    """Fill the caches through the batched input codec (ops/codec.py):
+    array-wide decompression + subgroup checks + hash-to-G2. Validation
+    failures come back as ValueError VALUES and are NOT cached, exactly
+    like the per-item `_cached` policy (the serial item loop re-derives
+    and raises them); at-capacity inserts evict like `_cached` too, so a
+    full cache never silently discards a whole prepped batch."""
+    from . import codec
+
+    if msgs:
+        for m, v in zip(msgs, codec.message_limbs_batch(msgs, DST)):
+            _cache_put(_MSG_CACHE, m, v)
+    if sigs:
+        for s, v in zip(sigs, codec.signature_limbs_batch(sigs)):
+            if not isinstance(v, ValueError):
+                _cache_put(_SIG_CACHE, s, v)
+    if pks:
+        for p, v in zip(pks, codec.pubkey_limbs_batch(pks)):
+            if not isinstance(v, ValueError):
+                _cache_put(_PK_CACHE, p, v)
+
 
 def prewarm_host_caches(messages: Sequence[bytes], signatures: Sequence[bytes],
                         pubkeys: Sequence[bytes] = ()):
-    """Fill the hash-to-G2, signature-decode, and pubkey caches with a
-    process pool.
+    """Fill the hash-to-G2, signature-decode, and pubkey caches.
 
-    The per-item host prep is pure-Python big-int work (hash_to_curve ~29 ms,
-    decode+subgroup ~8 ms) that would otherwise serialize an epoch's ~2k
-    distinct messages into minutes of single-core time before the device
-    sees a single byte. Pool size: CONSENSUS_SPECS_TPU_HASH_PROCS (default
-    min(8, cpus)); any pool failure falls back to the serial path."""
-    work = [("msg", m) for m in set(messages) if m not in _MSG_CACHE]
-    work += [("sig", s) for s in set(signatures) if s not in _SIG_CACHE]
-    work += [("pk", p) for p in set(pubkeys) if p not in _PK_CACHE]
+    Default path: the BATCHED input codec (ops/codec.py) — vectorized
+    decompression with shared square-root chains and a Montgomery
+    batch-inversion ladder, VM-program subgroup checks, and native-SHA
+    batched hash-to-G2 — one array-wide pass instead of per-item
+    pure-Python prep (which costs ~29 ms/hash + ~8 ms/decode and would
+    serialize an epoch's ~2k distinct messages into minutes).
+
+    CONSENSUS_SPECS_TPU_BATCH_CODEC=0 (or a codec failure) falls back to
+    the legacy process pool (CONSENSUS_SPECS_TPU_HASH_PROCS workers,
+    default min(8, cpus)); a pool failure latches `_POOL_BROKEN` and
+    degrades to the serial per-item path — both visible via PREP_STATS /
+    profiling gauges and recoverable via `reset_prep_state()`."""
+    msgs = [m for m in dict.fromkeys(messages) if m not in _MSG_CACHE]
+    sigs = [s for s in dict.fromkeys(signatures) if s not in _SIG_CACHE]
+    pks = [p for p in dict.fromkeys(pubkeys) if p not in _PK_CACHE]
+    total = len(msgs) + len(sigs) + len(pks)
+    if total == 0:
+        return
+    if _codec_enabled():
+        # no size floor here: the in-process codec has none of the pool's
+        # spawn overhead, and small duplicate-heavy serve flushes are
+        # exactly where per-item misses would stall the device stage
+        try:
+            _prewarm_batched(msgs, sigs, pks)
+            PREP_STATS["codec_batches"] += 1
+            PREP_STATS["codec_items"] += total
+            return
+        except Exception:
+            from . import profiling
+
+            profiling.record("bls.codec_prewarm_error", 0.0)
+            # fall through to the pool path
+    _prewarm_pool(msgs, sigs, pks)
+
+
+def _prewarm_pool(msgs, sigs, pks) -> None:
+    # re-filter: a codec prewarm that failed partway may already have
+    # cached some kinds — the pool must not re-pay ~29 ms/hash for them
+    work = [("msg", m) for m in msgs if m not in _MSG_CACHE]
+    work += [("sig", s) for s in sigs if s not in _SIG_CACHE]
+    work += [("pk", p) for p in pks if p not in _PK_CACHE]
     if len(work) < 16:
+        # pool spawn overhead would exceed the serial recompute; these
+        # items degrade to per-item prep in the verify loop — count them
+        if work:
+            _note_serial_fallback(len(work))
         return
     procs = int(
         os.environ.get(
@@ -344,10 +481,14 @@ def prewarm_host_caches(messages: Sequence[bytes], signatures: Sequence[bytes],
         )
     )
     if procs <= 1:
+        _note_serial_fallback(len(work))
         return
-    global _POOL_BROKEN
     if _POOL_BROKEN:
-        return  # a pool already hung/died this process: go straight serial
+        # a pool already hung/died this process: go straight serial (the
+        # latch is visible as the bls.prep_pool_broken gauge and clears
+        # via reset_prep_state())
+        _note_serial_fallback(len(work))
+        return
     try:
         import multiprocessing as mp
 
@@ -365,18 +506,20 @@ def prewarm_host_caches(messages: Sequence[bytes], signatures: Sequence[bytes],
             results = pool.map_async(_prewarm_worker, work, chunksize=8)
             for kind, payload, value in results.get(timeout=deadline):
                 if value is None:
+                    _note_serial_fallback(1)
                     continue  # transient worker failure: recompute serially
                 cache = {"msg": _MSG_CACHE, "sig": _SIG_CACHE,
                          "pk": _PK_CACHE}[kind]
-                if not isinstance(value, ValueError) and (
-                    len(cache) < _CACHE_CAPS[id(cache)]
-                ):
-                    cache[payload] = value
+                if not isinstance(value, ValueError):
+                    _cache_put(cache, payload, value)
+        PREP_STATS["pool_batches"] += 1
+        PREP_STATS["pool_items"] += len(work)
     except Exception:
         # serial fallback: the item loop computes on demand. Latch the
         # failure — without this, every subsequent batch would re-pay the
         # full pool deadline (>=120 s) before degrading, each time.
-        _POOL_BROKEN = True
+        _set_pool_broken(True)
+        _note_serial_fallback(len(work))
 
 
 def _flat_ints_to_oracle(coeffs: Sequence[int]) -> O.Fq12:

@@ -32,7 +32,16 @@ binding (reference utils/bls.py:17-22).
 """
 from typing import List, Sequence, Tuple
 
-from ..utils.bls12_381 import P, X_PARAM
+from ..utils.bls12_381 import (
+    ISO_X_DEN,
+    ISO_X_NUM,
+    ISO_Y_DEN,
+    ISO_Y_NUM,
+    P,
+    X_PARAM,
+    _PSI_CX,
+    _PSI_CY,
+)
 from .vm import Prog, Val
 
 # BLS parameter bit patterns (static schedules)
@@ -599,6 +608,221 @@ def build_aggregate_verify_miller(k_pairs: int, fold: int = 1) -> Prog:
     else:
         for t in range(fold):
             _emit_aggregate_verify_miller(prog, f"i{t}.", k_pairs)
+    return prog
+
+
+# ---------------------------------------------------------------------------
+# codec-plane programs (ops/codec.py): projective complete arithmetic on the
+# G2 curve (RCB over Fq2), psi endomorphism, subgroup checks, and the
+# hash-to-G2 finish (isogeny + cofactor clearing)
+# ---------------------------------------------------------------------------
+
+
+def _f2_mul_b3(v: F2) -> F2:
+    """v * b3 on the G2 curve: b = 4(1+u), b3 = 12(1+u) = 12 * xi."""
+    k = v.prog.const(12)
+    m = v.mul_xi()
+    return F2(m.c0 * k, m.c1 * k)
+
+
+def g2_complete_add(prog: Prog, p1, p2):
+    """(X3:Y3:Z3) = P1 + P2 on the G2 curve, complete (RCB 2016 algorithm 7
+    over Fq2; a = 0, b3 = 12(1+u)). E'(Fq2) has odd order (h2 and r are both
+    odd), so the formulas are complete for EVERY on-curve point — doubling,
+    infinity (0:1:0), and non-subgroup points included. That completeness is
+    what lets the subgroup-check and cofactor ladders below run with a
+    static, branch-free schedule on adversarial inputs."""
+    X1, Y1, Z1 = p1
+    X2, Y2, Z2 = p2
+
+    t0 = X1 * X2
+    t1 = Y1 * Y2
+    t2 = Z1 * Z2
+    t3 = (X1 + Y1) * (X2 + Y2)
+    t3 = t3 - (t0 + t1)  # X1Y2 + X2Y1
+    t4 = (Y1 + Z1) * (Y2 + Z2)
+    t4 = t4 - (t1 + t2)  # Y1Z2 + Y2Z1
+    X3 = (X1 + Z1) * (X2 + Z2)
+    Y3 = X3 - (t0 + t2)  # X1Z2 + X2Z1
+    X3 = t0 + t0
+    t0 = X3 + t0  # 3 X1X2
+    t2 = _f2_mul_b3(t2)
+    Z3 = t1 + t2
+    t1 = t1 - t2
+    Y3 = _f2_mul_b3(Y3)
+    X3 = t4 * Y3
+    t2 = t3 * t1
+    X3 = t2 - X3
+    Y3 = Y3 * t0
+    t1 = t1 * Z3
+    Y3 = t1 + Y3
+    t0 = t0 * t3
+    Z3 = Z3 * t4
+    Z3 = Z3 + t0
+    return (X3, Y3, Z3)
+
+
+def g2_neg(p):
+    X, Y, Z = p
+    return (X, Y.neg(), Z)
+
+
+def g2_scalar_mul_abs_x(prog: Prog, p):
+    """[|x|]P (x the BLS parameter) via complete double-and-add over the
+    STATIC msb-first bit string — 63 doublings + 5 additions, no selects."""
+    acc = p
+    for bit in ABS_X_BITS[1:]:
+        acc = g2_complete_add(prog, acc, acc)
+        if bit:
+            acc = g2_complete_add(prog, acc, p)
+    return acc
+
+
+_PSI_CX_INTS = (_PSI_CX.c0, _PSI_CX.c1)
+_PSI_CY_INTS = (_PSI_CY.c0, _PSI_CY.c1)
+
+
+def g2_psi(prog: Prog, p):
+    """p-power endomorphism on projective G2 points: the affine map
+    (x, y) -> (cx * conj(x), cy * conj(y)) lifts to
+    (X:Y:Z) -> (cx conj(X) : cy conj(Y) : conj(Z)) because conj is a field
+    automorphism of Fq2/Fq (so it commutes with the X/Z, Y/Z divisions)."""
+    X, Y, Z = p
+    return (
+        X.conj().mul_const(_PSI_CX_INTS),
+        Y.conj().mul_const(_PSI_CY_INTS),
+        Z.conj(),
+    )
+
+
+def _emit_g2_subgroup_check(prog: Prog, ns: str) -> None:
+    """psi criterion (oracle utils/bls12_381.py is_in_g2_subgroup): an
+    on-curve affine P is in the order-r subgroup iff psi(P) == -[|x|]P.
+    Emits the comparison CROSS-MULTIPLIED (psi(P) has Z = 1): outputs
+    d.0..d.3 are the Fq coefficients of psi_x*Q_Z - Q_X and psi_y*Q_Z + Q_Y
+    for Q = [|x|]P — the host checks all four are 0 mod p. If the ladder
+    lands on infinity (0:Y:0) the d.2/d.3 outputs equal psi_y*0 + Y != 0,
+    matching the oracle's False for that case."""
+    x = f2_inputs(prog, f"{ns}pt.x")
+    y = f2_inputs(prog, f"{ns}pt.y")
+    one = f2_const(prog, 1, 0)
+    q = g2_scalar_mul_abs_x(prog, (x, y, one))
+    px = x.conj().mul_const(_PSI_CX_INTS)
+    py = y.conj().mul_const(_PSI_CY_INTS)
+    dx = px * q[2] - q[0]
+    dy = py * q[2] + q[1]
+    prog.out(dx.c0, f"{ns}d.0")
+    prog.out(dx.c1, f"{ns}d.1")
+    prog.out(dy.c0, f"{ns}d.2")
+    prog.out(dy.c1, f"{ns}d.3")
+
+
+def build_g2_subgroup_check(fold: int = 1) -> Prog:
+    """Codec program: batched G2 subgroup membership via the psi criterion.
+    Inputs pt.{x,y}.{0,1} (affine Fq2, must be ON the curve — decompression
+    guarantees that); outputs d.0..d.3 (all 0 mod p iff member)."""
+    prog = Prog()
+    if fold == 1:
+        _emit_g2_subgroup_check(prog, "")
+    else:
+        for t in range(fold):
+            _emit_g2_subgroup_check(prog, f"i{t}.")
+    return prog
+
+
+_R_BITS = [int(b) for b in bin(_R_ORDER)[2:]]
+
+
+def _emit_g1_subgroup_check(prog: Prog, ns: str) -> None:
+    """Definitional [r]P ladder with complete additions (E(Fq) also has odd
+    order, so the static schedule is exception-free on every on-curve
+    input). Output rz is the projective Z of [r]P: 0 mod p iff member."""
+    x = prog.inp(f"{ns}pt.x")
+    y = prog.inp(f"{ns}pt.y")
+    p = (x, y, prog.const(1))
+    acc = p
+    for bit in _R_BITS[1:]:
+        acc = g1_complete_add(prog, acc, acc)
+        if bit:
+            acc = g1_complete_add(prog, acc, p)
+    prog.out(acc[2], f"{ns}rz")
+
+
+def build_g1_subgroup_check(fold: int = 1) -> Prog:
+    """Codec program: batched G1 subgroup membership ([r]P == infinity).
+    Inputs pt.{x,y} (affine Fq, on curve); output rz (0 mod p iff member)."""
+    prog = Prog()
+    if fold == 1:
+        _emit_g1_subgroup_check(prog, "")
+    else:
+        for t in range(fold):
+            _emit_g1_subgroup_check(prog, f"i{t}.")
+    return prog
+
+
+def _f2_horner(prog: Prog, coeffs, x: F2) -> F2:
+    """Evaluate sum_i coeffs[i] x^i (coeffs are oracle Fq2 constants)."""
+    acc = f2_const(prog, coeffs[-1].c0, coeffs[-1].c1)
+    for c in reversed(coeffs[:-1]):
+        acc = acc * x + f2_const(prog, c.c0, c.c1)
+    return acc
+
+
+def _emit_iso_map_g2(prog: Prog, x: F2, y: F2):
+    """RFC 9380 3-isogeny E'_SSWU -> G2 curve, PROJECTIVELY: with
+    x_E = x_num/x_den and y_E = y * y_num/y_den, the image is
+    (X:Y:Z) = (x_num*y_den : y*y_num*x_den : x_den*y_den) — no inversion
+    anywhere on device; the host divides once per batch at the end."""
+    xn = _f2_horner(prog, ISO_X_NUM, x)
+    xd = _f2_horner(prog, ISO_X_DEN, x)
+    yn = _f2_horner(prog, ISO_Y_NUM, x)
+    yd = _f2_horner(prog, ISO_Y_DEN, x)
+    return (xn * yd, y * (yn * xd), xd * yd)
+
+
+def _emit_h2g_finish(prog: Prog, ns: str) -> None:
+    q0x = f2_inputs(prog, f"{ns}q0.x")
+    q0y = f2_inputs(prog, f"{ns}q0.y")
+    q1x = f2_inputs(prog, f"{ns}q1.x")
+    q1y = f2_inputs(prog, f"{ns}q1.y")
+    p0 = _emit_iso_map_g2(prog, q0x, q0y)
+    p1 = _emit_iso_map_g2(prog, q1x, q1y)
+    r = g2_complete_add(prog, p0, p1)
+    # clear_cofactor: the Budroni-Pintore psi decomposition, identical to
+    # the oracle's clear_cofactor_g2:
+    #   [h_eff]P = [x^2]P + [-x]P - P - [-x]psi(P) - psi(P) + psi(psi(2P))
+    t1 = g2_scalar_mul_abs_x(prog, r)          # [|x|]P = [-x]P
+    txx = g2_scalar_mul_abs_x(prog, t1)        # [x^2]P
+    psi_p = g2_psi(prog, r)
+    t2 = g2_scalar_mul_abs_x(prog, psi_p)      # [-x]psi(P)
+    psi2_2p = g2_psi(prog, g2_psi(prog, g2_complete_add(prog, r, r)))
+    acc = g2_complete_add(prog, txx, t1)
+    acc = g2_complete_add(prog, acc, g2_neg(r))
+    acc = g2_complete_add(prog, acc, g2_neg(t2))
+    acc = g2_complete_add(prog, acc, g2_neg(psi_p))
+    acc = g2_complete_add(prog, acc, psi2_2p)
+    for name, comp in zip(("x", "y", "z"), acc):
+        prog.out(comp.c0, f"{ns}h.{name}.0")
+        prog.out(comp.c1, f"{ns}h.{name}.1")
+
+
+def build_h2g_finish(fold: int = 1) -> Prog:
+    """Codec program: the device part of hash_to_g2 — 3-isogeny evaluation
+    of both SSWU points, their addition, and cofactor clearing, all with
+    complete projective arithmetic (the ~75% of hash-to-G2 field work that
+    needs no data-dependent branching).
+
+    Inputs q{0,1}.{x,y}.{0,1}: the two map_to_curve_sswu_g2 outputs (affine
+    Fq2 on the isogenous curve, from the host's batched SSWU).
+    Outputs h.{x,y,z}.{0,1}: the hashed G2 point, PROJECTIVE (x = X/Z,
+    y = Y/Z) — the host converts a whole batch affine with one
+    batch-inversion ladder."""
+    prog = Prog()
+    if fold == 1:
+        _emit_h2g_finish(prog, "")
+    else:
+        for t in range(fold):
+            _emit_h2g_finish(prog, f"i{t}.")
     return prog
 
 

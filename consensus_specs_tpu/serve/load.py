@@ -54,6 +54,11 @@ class FailingBackendProxy:
         self._maybe_fail()
         return self._backend.batch_aggregate_verify(*args, **kwargs)
 
+    def prewarm_host_caches(self, *args, **kwargs):
+        # codec prep never fails here: the injection targets the device
+        # hard part, prep degradation has its own PREP_STATS counters
+        return self._backend.prewarm_host_caches(*args, **kwargs)
+
 
 def build_committees(n_committees: int, k: int, seed: int = 7
                      ) -> List[Tuple[list, bytes, bytes, bool]]:
@@ -198,6 +203,14 @@ def run_serve_bench(target: float = TARGET_PER_CHIP) -> dict:
         p95_ms=snap["latency"].get("p95_ms", 0.0),
         p99_ms=snap["latency"].get("p99_ms", 0.0),
         batches=snap["batches"],
+        # prep-vs-device split: where each flush's time goes (host codec
+        # prep of the NEXT batch overlaps the device hard part, so the
+        # pipeline's critical path is max(prep, device), not the sum)
+        prep_ms_per_flush=snap["prep_ms_per_flush"],
+        device_ms_per_flush=snap["device_ms_per_flush"],
+        prep_serial_fallback_items=snap["prep"].get(
+            "serial_fallback_items", 0
+        ),
         fallback_items=snap["fallback_items"],
         fault_injected=bool(inject and getattr(backend, "fired", 0)),
         lost=lost,

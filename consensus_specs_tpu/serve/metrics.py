@@ -13,6 +13,7 @@ from ..ops import profiling
 
 LATENCY_LABEL = "serve.submit_to_result"
 BATCH_LABEL = "serve.batch_flush"
+PREP_LABEL = "serve.prep_flush"
 
 
 def _pow2(n: int) -> int:
@@ -49,6 +50,15 @@ class ServeMetrics:
         self.fallback_batches = 0
         self.fallback_items = 0
         self.queue_depth_peak = 0
+        # prep-vs-device time split (the two pipeline stages): where a
+        # flush's wall time goes — host codec prep or the device hard
+        # part. device_flushes counts whole flushes (like prep_batches)
+        # so the two per-flush means share a denominator shape; `batches`
+        # above counts (kind, K-bucket) GROUPS, of which a flush has >= 1
+        self.prep_batches = 0
+        self.prep_s = 0.0
+        self.device_flushes = 0
+        self.device_s = 0.0
 
     # -- recording hooks (service.py) --------------------------------------
 
@@ -74,6 +84,12 @@ class ServeMetrics:
             self.queue_depth_peak = max(self.queue_depth_peak, queue_depth)
         profiling.set_gauge("serve.queue_depth", queue_depth)
 
+    def note_prep(self, seconds: float) -> None:
+        with self._lock:
+            self.prep_batches += 1
+            self.prep_s += seconds
+        profiling.record(PREP_LABEL, seconds)
+
     def note_batch(self, n_items: int, sum_k: int, bucket: int,
                    seconds: float) -> None:
         rows = _pow2(max(1, n_items))
@@ -84,6 +100,11 @@ class ServeMetrics:
             self.lanes_filled += sum_k
             self.lanes_padded += rows * bucket
         profiling.record(BATCH_LABEL, seconds)
+
+    def note_device_flush(self, seconds: float) -> None:
+        with self._lock:
+            self.device_flushes += 1
+            self.device_s += seconds
 
     def note_retry(self) -> None:
         with self._lock:
@@ -123,7 +144,25 @@ class ServeMetrics:
     def snapshot(self) -> Dict[str, float]:
         self.export_gauges()
         lat = profiling.latency_summary().get(LATENCY_LABEL, {})
+        # backend prep-plane counters (which path warmed the caches, how
+        # many items degraded to serial per-item prep, pool-broken latch)
+        # — process-global like the caches they describe
+        try:
+            from ..ops import bls_backend
+
+            prep_stats = dict(bls_backend.PREP_STATS)
+            prep_stats["pool_broken"] = bool(bls_backend._POOL_BROKEN)
+        except Exception:
+            prep_stats = {}
         with self._lock:
+            prep_ms = (
+                1e3 * self.prep_s / self.prep_batches
+                if self.prep_batches else 0.0
+            )
+            device_ms = (
+                1e3 * self.device_s / self.device_flushes
+                if self.device_flushes else 0.0
+            )
             return {
                 "submits": self.submits,
                 "eager": self.eager,
@@ -138,5 +177,12 @@ class ServeMetrics:
                 "fallback_batches": self.fallback_batches,
                 "fallback_items": self.fallback_items,
                 "queue_depth_peak": self.queue_depth_peak,
+                "prep_batches": self.prep_batches,
+                "device_flushes": self.device_flushes,
+                "prep_ms_per_flush": round(prep_ms, 3),
+                "prep_ms_total": round(1e3 * self.prep_s, 3),
+                "device_ms_per_flush": round(device_ms, 3),
+                "device_ms_total": round(1e3 * self.device_s, 3),
+                "prep": prep_stats,
                 "latency": lat,
             }

@@ -8,11 +8,19 @@ deadline. Committee-based consensus throughput is bounded by exactly this
 aggregate-verification loop (arXiv:2302.00418), and the fix is the same
 continuous-batching shape every inference-serving stack uses:
 
-  submit() -> bounded ingress queue -> background worker forms a batch
-  (flush on max_batch OR max_wait_ms, whichever first) -> requests are
-  grouped by (kind, K bucket) so padded device shapes reuse the existing
-  jit/VM program cache -> one batched backend call per group -> futures
-  resolve.
+  submit() -> bounded ingress queue -> PREP stage forms a batch (flush on
+  max_batch OR max_wait_ms, whichever first) and runs the host codec
+  (ops/codec.py via prewarm_host_caches: batched decompression, subgroup
+  checks, hash-to-G2) -> hand-off queue -> DEVICE stage groups requests
+  by (kind, K bucket) so padded device shapes reuse the existing jit/VM
+  program cache -> one batched backend call per group -> futures resolve.
+
+The two stages are a pipeline: while the device stage runs the pairing
+hard part of micro-batch N, the prep stage is already decoding/hashing
+micro-batch N+1 — the device never idles waiting on host prep. The
+hand-off queue holds at most one prepped batch, so prep can run at most
+one batch ahead (caches stay bounded, backpressure still propagates to
+submit()).
 
 Robustness: a device error on a batch is retried once (transient), then
 the whole group degrades to the pure-Python oracle sequentially — a
@@ -27,6 +35,7 @@ context — the default fallback oracle is captured from the bls
 switchboard at __init__ time, and inside a collector those names are the
 recording interceptors.
 """
+import queue
 import threading
 import time
 from collections import deque
@@ -112,14 +121,28 @@ class VerificationService:
         self._work = threading.Condition(self._lock)      # queue gained items / closing
         self._not_full = threading.Condition(self._lock)  # queue lost items
         self._queue: "deque[_Pending]" = deque()
+        # requests pulled by the prep stage but not yet taken by the
+        # device stage: counted against max_queue so the pipeline's
+        # look-ahead cannot widen the backpressure bound
+        self._staged = 0
         self._inflight = {}  # key -> _Pending (queued or mid-batch)
         self._cache = ResultCache(cache_capacity)
         self.metrics = ServeMetrics()
         self._closed = False
+        # two-stage pipeline: prep(N+1) overlaps device(N) through a
+        # one-slot hand-off queue
+        self._handoff: "queue.Queue[Optional[List[_Pending]]]" = queue.Queue(
+            maxsize=1
+        )
         self._worker = threading.Thread(
-            target=self._run, name="verification-service", daemon=True
+            target=self._run, name="verification-service-prep", daemon=True
+        )
+        self._device_worker = threading.Thread(
+            target=self._device_run, name="verification-service-device",
+            daemon=True,
         )
         self._worker.start()
+        self._device_worker.start()
 
     # -- ingress ------------------------------------------------------------
 
@@ -184,7 +207,7 @@ class VerificationService:
                     # same content already queued/verifying: share its Future
                     self.metrics.note_inflight_join()
                     return pend.future
-                if len(self._queue) < self._max_queue:
+                if len(self._queue) + self._staged < self._max_queue:
                     break
                 remaining = (None if deadline is None
                              else deadline - time.perf_counter())
@@ -212,6 +235,7 @@ class VerificationService:
             self._work.notify_all()
             self._not_full.notify_all()
         self._worker.join(timeout)
+        self._device_worker.join(timeout)
 
     def __enter__(self):
         return self
@@ -239,10 +263,54 @@ class VerificationService:
         return self._backend
 
     def _run(self):
+        """PREP stage: collect a micro-batch, run the host codec on it,
+        hand it to the device stage. While the device stage verifies
+        batch N this loop is already prepping batch N+1."""
         while True:
             batch = self._collect()
             if batch is None:
+                self._handoff.put(None)  # drain sentinel
                 return
+            t0 = time.perf_counter()
+            try:
+                self._prep(batch)
+            except Exception:
+                # prep is a throughput optimization only: the device
+                # stage's per-item cache misses re-derive (and re-raise)
+                # whatever prep could not produce
+                profiling.record("serve.prep_error", 0.0)
+            self.metrics.note_prep(time.perf_counter() - t0)
+            self._handoff.put(batch)
+
+    def _prep(self, batch: List[_Pending]) -> None:
+        """Warm the backend's host caches for the whole micro-batch with
+        the batched input codec (decompression + subgroup checks +
+        hash-to-G2 in array-wide passes)."""
+        backend = self._resolve_backend()
+        prewarm = getattr(backend, "prewarm_host_caches", None)
+        if prewarm is None:
+            return  # oracle-only / test backends have no host caches
+        msgs: List[bytes] = []
+        sigs: List[bytes] = []
+        pks: List[bytes] = []
+        for p in batch:
+            if p.kind == "fast_aggregate":
+                msgs.append(p.messages)
+            else:
+                msgs.extend(p.messages)
+            sigs.append(p.signature)
+            pks.extend(p.pubkeys)
+        prewarm(msgs, sigs, pks)
+
+    def _device_run(self):
+        """DEVICE stage: drain prepped batches and run the hard part."""
+        while True:
+            batch = self._handoff.get()
+            if batch is None:
+                return
+            with self._lock:
+                self._staged -= len(batch)
+                self._not_full.notify_all()
             try:
                 self._process(batch)
             except Exception:
@@ -271,14 +339,15 @@ class VerificationService:
                 self._work.wait(remaining)
             n = min(self._max_batch, len(self._queue))
             batch = [self._queue.popleft() for _ in range(n)]
+            self._staged += n
             profiling.set_gauge("serve.queue_depth", len(self._queue))
-            self._not_full.notify_all()
             return batch
 
     def _process(self, batch: List[_Pending]) -> None:
         groups = {}
         for p in batch:
             groups.setdefault((p.kind, p.bucket), []).append(p)
+        t_flush = time.perf_counter()
         for (kind, bucket), pends in groups.items():
             t0 = time.perf_counter()
             results = self._verify_group(kind, pends)
@@ -287,6 +356,9 @@ class VerificationService:
                 time.perf_counter() - t0,
             )
             self._settle(pends, results)
+        # whole-flush device time (all groups): the prep/device split is
+        # per FLUSH on both sides, so the means share a denominator shape
+        self.metrics.note_device_flush(time.perf_counter() - t_flush)
         self.metrics.export_gauges()
 
     def _verify_group(self, kind: str, pends: List[_Pending]) -> List[bool]:

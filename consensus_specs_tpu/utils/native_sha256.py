@@ -5,31 +5,68 @@ messages in ONE native call — the merkleization inner loop
 (utils/merkle_minimal.py, utils/ssz/ssz_typing.py merkleize_chunks) calls it
 once per tree layer instead of once per node pair through hashlib.
 
+``hash_many(messages) -> list[bytes]`` hashes a batch of VARIABLE-length
+messages in one native call — the expand_message_xmd rounds of the batched
+hash-to-G2 codec (consensus_specs_tpu/ops/codec.py) call it once per XMD
+round instead of once per message.
+
 The shared object is built on demand (`make native`, or lazily here when a
-compiler is available); everything falls back to hashlib when it isn't —
+compiler is available); a stale .so predating ``sha256_hash_many`` is
+rebuilt once. Everything falls back to hashlib when no compiler exists —
 the native path is a throughput component, never a correctness dependency.
 """
 import ctypes
 import hashlib
+import os
 import subprocess
 from pathlib import Path
+from typing import List, Sequence
 
 _REPO = Path(__file__).resolve().parents[2]
 _SRC = _REPO / "csrc" / "sha256_batch.c"
 _SO = _REPO / "csrc" / "libsha256_batch.so"
 
 _lib = None
+_has_many = False
 
 
 def _build() -> bool:
+    """Compile to a temp path, then os.replace onto the final name: the
+    rename gives the .so a fresh inode, so processes still mapping the
+    OLD library keep their (old-inode) text pages intact, and a re-CDLL
+    of the path resolves to the new dev/ino instead of the stale cached
+    handle. Compiling straight onto the dlopened path would truncate a
+    live mapping (SIGBUS / garbage instructions on the next call)."""
+    tmp = _SO.with_suffix(".so.%d.tmp" % os.getpid())
     try:
         subprocess.run(
-            ["gcc", "-O3", "-fPIC", "-shared", "-o", str(_SO), str(_SRC)],
+            ["gcc", "-O3", "-fPIC", "-shared", "-o", str(tmp), str(_SRC)],
             check=True, capture_output=True, timeout=120,
         )
+        os.replace(tmp, _SO)
         return True
     except Exception:
+        tmp.unlink(missing_ok=True)
         return False
+
+
+def _bind(lib) -> bool:
+    """Declare signatures; returns whether the hash_many symbol exists."""
+    global _has_many
+    lib.sha256_hash_pairs.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
+    ]
+    lib.sha256_hash_pairs.restype = None
+    try:
+        lib.sha256_hash_many.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_char_p, ctypes.c_size_t,
+        ]
+        lib.sha256_hash_many.restype = None
+        _has_many = True
+    except AttributeError:
+        _has_many = False
+    return _has_many
 
 
 def _load():
@@ -42,10 +79,10 @@ def _load():
             return _lib
     try:
         lib = ctypes.CDLL(str(_SO))
-        lib.sha256_hash_pairs.argtypes = [
-            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
-        ]
-        lib.sha256_hash_pairs.restype = None
+        if not _bind(lib) and _SRC.exists() and _build():
+            # stale .so from before sha256_hash_many: rebuilt — reload
+            lib = ctypes.CDLL(str(_SO))
+            _bind(lib)
         _lib = lib
     except OSError:
         _lib = False
@@ -70,3 +107,19 @@ def hash_pairs(data: bytes) -> bytes:
     buf = ctypes.create_string_buffer(32 * n)
     lib.sha256_hash_pairs(data, buf, n)
     return buf.raw
+
+
+def hash_many(messages: Sequence[bytes]) -> List[bytes]:
+    """SHA-256 of each (variable-length) message, one native call for the
+    whole batch; hashlib fallback when the native symbol is unavailable."""
+    n = len(messages)
+    if n == 0:
+        return []
+    lib = _load()
+    if not lib or not _has_many:
+        return [hashlib.sha256(m).digest() for m in messages]
+    lens = (ctypes.c_uint64 * n)(*[len(m) for m in messages])
+    out = ctypes.create_string_buffer(32 * n)
+    lib.sha256_hash_many(b"".join(messages), lens, out, n)
+    raw = out.raw
+    return [raw[32 * i : 32 * (i + 1)] for i in range(n)]

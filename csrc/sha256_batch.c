@@ -9,6 +9,12 @@
  *       Python hashlib call per node pair. Every message is exactly one
  *       data block + one constant padding block, so the whole layer runs
  *       without branching or allocation.
+ *   void sha256_hash_many(const uint8_t* in, const uint64_t* lens,
+ *                         uint8_t* out, size_t n)
+ *     - hashes n independent VARIABLE-length messages (concatenated in
+ *       `in`, per-message byte lengths in `lens`) into n 32-byte digests:
+ *       one C call per expand_message_xmd round for a whole hash-to-G2
+ *       batch (the input codec plane, consensus_specs_tpu/ops/codec.py).
  *
  * Build: make native (gcc -O3 -fPIC -shared).
  */
@@ -88,4 +94,40 @@ static void sha256_64(const uint8_t in[64], uint8_t out[32]) {
 void sha256_hash_pairs(const uint8_t* in, uint8_t* out, size_t n) {
     for (size_t i = 0; i < n; i++)
         sha256_64(in + 64 * i, out + 32 * i);
+}
+
+static void sha256_any(const uint8_t* msg, size_t len, uint8_t* out) {
+    uint32_t st[8];
+    memcpy(st, IV, sizeof st);
+    size_t full = len / 64;
+    for (size_t b = 0; b < full; b++)
+        compress(st, msg + 64 * b);
+    size_t rem = len - 64 * full;
+    uint8_t tail[128];
+    memset(tail, 0, sizeof tail);
+    memcpy(tail, msg + 64 * full, rem);
+    tail[rem] = 0x80;
+    size_t tlen = (rem + 9 <= 64) ? 64 : 128;
+    uint64_t bitlen = (uint64_t)len * 8;
+    for (int k = 0; k < 8; k++)
+        tail[tlen - 1 - k] = (uint8_t)(bitlen >> (8 * k));
+    compress(st, tail);
+    if (tlen == 128)
+        compress(st, tail + 64);
+    for (int i = 0; i < 8; i++) {
+        out[4*i]   = (uint8_t)(st[i] >> 24);
+        out[4*i+1] = (uint8_t)(st[i] >> 16);
+        out[4*i+2] = (uint8_t)(st[i] >> 8);
+        out[4*i+3] = (uint8_t)(st[i]);
+    }
+}
+
+void sha256_hash_many(const uint8_t* in, const uint64_t* lens,
+                      uint8_t* out, size_t n) {
+    size_t off = 0;
+    for (size_t i = 0; i < n; i++) {
+        size_t len = (size_t)lens[i];
+        sha256_any(in + off, len, out + 32 * i);
+        off += len;
+    }
 }

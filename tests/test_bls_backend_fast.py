@@ -20,3 +20,103 @@ def test_single_verify_and_k2_bucket():
         [[pk1, pk2], [pk1]], [msg, msg], [agg, agg]
     )
     assert list(got) == [True, False]
+
+
+# -- _cached eviction semantics (ISSUE 2 satellite) --------------------------
+
+
+def _with_cap(cache, cap):
+    from consensus_specs_tpu.ops import bls_backend
+
+    bls_backend._CACHE_CAPS[id(cache)] = cap
+    return cache
+
+
+def test_cached_hit_refreshes_recency_order():
+    from consensus_specs_tpu.ops.bls_backend import _CACHE_CAPS, _cached
+
+    cache = _with_cap({}, 8)
+    try:
+        for i in range(4):
+            _cached(cache, bytes([i]), lambda k: ("v", k))
+        _cached(cache, b"\x01", lambda k: ("new", k))  # hit: no recompute
+        assert cache[b"\x01"] == ("v", b"\x01")
+        # dict order IS recency order: the hit key moved last
+        assert list(cache.keys()) == [b"\x00", b"\x02", b"\x03", b"\x01"]
+    finally:
+        del _CACHE_CAPS[id(cache)]
+
+
+def test_cached_half_eviction_drops_only_cold_half():
+    from consensus_specs_tpu.ops.bls_backend import _CACHE_CAPS, _cached
+
+    cache = _with_cap({}, 8)
+    try:
+        for i in range(8):
+            _cached(cache, bytes([i]), lambda k: k)
+        for i in (0, 1, 2, 3):  # refresh the first four: now hottest
+            _cached(cache, bytes([i]), lambda k: None)
+        _cached(cache, b"\x63", lambda k: k)  # overflow -> evict cold half
+        assert sorted(cache.keys()) == [
+            b"\x00", b"\x01", b"\x02", b"\x03", b"\x63"
+        ]
+    finally:
+        del _CACHE_CAPS[id(cache)]
+
+
+def test_cached_valueerror_never_cached_and_reraised():
+    from consensus_specs_tpu.ops.bls_backend import _CACHE_CAPS, _cached
+
+    calls = []
+    cache = _with_cap({}, 8)
+
+    def compute(k):
+        calls.append(k)
+        return ValueError("bad input")
+
+    try:
+        for _ in range(2):
+            try:
+                _cached(cache, b"k", compute)
+                assert False, "expected ValueError"
+            except ValueError as e:
+                assert str(e) == "bad input"
+        assert cache == {}  # never cached ...
+        assert len(calls) == 2  # ... so every miss recomputes
+    finally:
+        del _CACHE_CAPS[id(cache)]
+
+
+def test_prewarm_codec_path_skips_invalid_values():
+    """The batched-codec prewarm fills caches exactly like _cached would:
+    validation failures (ValueError VALUES) never enter, valid items do."""
+    from consensus_specs_tpu.ops import bls_backend
+
+    sks = list(range(201, 221))
+    pks = [bls.SkToPk(sk) for sk in sks]
+    bad_pk = b"\xa0" + b"\x01" * 47  # not on curve
+    inf_pk = b"\xc0" + b"\x00" * 47  # infinity: KeyValidate rejects
+    for pk in pks + [bad_pk, inf_pk]:
+        bls_backend._PK_CACHE.pop(pk, None)
+    before = dict(bls_backend.PREP_STATS)
+    bls_backend.prewarm_host_caches([], [], pks + [bad_pk, inf_pk])
+    assert all(pk in bls_backend._PK_CACHE for pk in pks)
+    assert bad_pk not in bls_backend._PK_CACHE
+    assert inf_pk not in bls_backend._PK_CACHE
+    assert (
+        bls_backend.PREP_STATS["codec_items"]
+        == before["codec_items"] + len(pks) + 2
+    )
+
+
+def test_reset_prep_state_clears_pool_latch_and_counters():
+    from consensus_specs_tpu.ops import bls_backend, profiling
+
+    bls_backend._set_pool_broken(True)
+    assert bls_backend._POOL_BROKEN is True
+    assert bls_backend.PREP_STATS["pool_broken_latches"] >= 1
+    assert profiling.summary()["bls.prep_pool_broken"]["gauge"] == 1.0
+    bls_backend.reset_prep_state()
+    assert bls_backend._POOL_BROKEN is False
+    assert all(v == 0 for v in bls_backend.PREP_STATS.values())
+    assert profiling.summary()["bls.prep_pool_broken"]["gauge"] == 0.0

@@ -52,3 +52,17 @@ def test_layer_batching_throughput_sanity():
     # the native layer call must at least be in the same league; typically
     # it wins on per-call overhead (this is a sanity check, not a benchmark)
     assert native_dt < hashlib_dt * 3
+
+
+def test_hash_many_matches_hashlib():
+    """Variable-length batched hashing (the expand_message_xmd backend):
+    length edges around the SHA block/padding boundaries, empty message,
+    empty batch — and hashlib-fallback equality when native is absent."""
+    rng = Random(67)
+    msgs = [b"", b"a", b"x" * 55, b"y" * 56, b"z" * 64, b"w" * 119,
+            b"v" * 120, b"u" * 200]
+    msgs += [bytes(rng.getrandbits(8) for _ in range(rng.randrange(300)))
+             for _ in range(32)]
+    got = native_sha256.hash_many(msgs)
+    assert got == [hashlib.sha256(m).digest() for m in msgs]
+    assert native_sha256.hash_many([]) == []

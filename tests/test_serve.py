@@ -411,3 +411,31 @@ def test_collector_flush_routes_through_service():
     assert np.array_equal(got, col.flush_oracle())
     assert list(got) == [True, True, False]
     assert be.items == 2  # duplicate collapsed before submission
+
+
+def test_pipeline_prep_device_split_in_snapshot():
+    """The two-stage pipeline reports where flush time goes: every flush
+    gets a prep-stage timing (even for backends with no host caches) and
+    a device-stage timing, and the snapshot carries the backend prep-plane
+    counters (serial-fallback items, pool-broken latch)."""
+    be = CountingBackend()
+    svc = VerificationService(backend=be, max_batch=4, max_wait_ms=5)
+    try:
+        futs = [
+            svc.submit("fast_aggregate", [PK], b"m%d" % i, b"s%d-ok" % i)
+            for i in range(8)
+        ]
+        assert all(f.result(timeout=10) is True for f in futs)
+    finally:
+        svc.close(timeout=30)
+    snap = svc.metrics.snapshot()
+    assert snap["prep_batches"] >= 1
+    # both split counters are per FLUSH (a flush can hold several
+    # (kind, K-bucket) groups, counted separately by `batches`)
+    assert snap["prep_batches"] == snap["device_flushes"] > 0
+    assert snap["batches"] >= snap["device_flushes"]
+    for key in ("prep_ms_per_flush", "prep_ms_total",
+                "device_ms_per_flush", "device_ms_total"):
+        assert snap[key] >= 0.0
+    assert "serial_fallback_items" in snap["prep"]
+    assert "pool_broken" in snap["prep"]
