@@ -98,6 +98,14 @@ serve-bench:
 codec-bench:
 	JAX_PLATFORMS=cpu python bench.py --mode codec
 
+# final-exp microbenchmark: per-item easy+hard finalization vs the RLC
+# combine (one final exponentiation per batch) on identical Miller
+# outputs, items/sec across N in {4,16,64,256}; the JSON line's
+# vs_baseline field is the RLC-over-per-item speedup at N=16 (> 1 means
+# the combine wins at the acceptance bar; RLC_BENCH_* env resizes)
+rlc-bench:
+	JAX_PLATFORMS=cpu python bench.py --mode rlc
+
 multichip:
 	python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('multichip OK')"
 

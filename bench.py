@@ -50,6 +50,11 @@ split per flush (knobs: SERVE_* env vars, see serve/load.py).
 `--mode codec` is the prep-only microbenchmark: the batched input codec
 (ops/codec.py) vs the per-item pure-Python prep path, items/sec over
 CODEC_ITEMS items per kind — no pairings, just the front-door cost.
+
+`--mode rlc` is the final-exp microbenchmark: per-item easy+hard
+finalization vs the random-linear-combination combine
+(bls_backend.batch_verify_rlc's core) on identical Miller outputs,
+items/sec across N in {4,16,64,256} (RLC_BENCH_* env).
 """
 import json
 import os
@@ -95,6 +100,13 @@ def _workload_params(on_cpu: bool, override=None):
 
 TARGET_PER_CHIP = 150_000 / 8  # north star: 300k sigs < 2 s on 8 chips
 
+# the stage-0 liveness shape (tiny committee: a nonzero number lands within
+# ~a minute of any grant) — ONE constant shared by every emission site AND
+# _best_line's headline demotion, so resizing it cannot silently let its
+# inflated per-sig rate shadow the comparable 32x128 number again
+_WARMUP_SHAPE = (4, 8)
+_WARMUP_OVERRIDE = _WARMUP_SHAPE + (1, "committee")
+
 
 def _bench_env_overridden() -> bool:
     """True when the caller pinned any workload knob — quick-path
@@ -118,7 +130,7 @@ def run_workload(emit_partial=None, override=None, child_quick=False) -> dict:
 
     platform = jax.default_backend()
     if child_quick and platform == "cpu" and not _bench_env_overridden():
-        override = (4, 8, 1, "committee")
+        override = _WARMUP_OVERRIDE
     n, k, reps, mode = _workload_params(on_cpu=platform == "cpu", override=override)
 
     if mode == "epoch":
@@ -233,15 +245,39 @@ def _init_backend_with_watchdog(exit_fn=None) -> bool:
         done.set()
 
 
+def _shape_key(parsed: dict) -> str:
+    """per_mode_best key: committee lines carry their (n, k) shape so the
+    stage-0 tiny shape and the round-over-round comparable 32x128 shape
+    never share a slot (ADVICE round 5: keying by mode alone let the
+    warmup shape shadow the headline committee number)."""
+    mode = parsed.get("mode", "committee")
+    n, k = parsed.get("n"), parsed.get("k")
+    if mode == "committee" and n and k:
+        return f"committee[{n}x{k}]"
+    return mode
+
+
+def _is_warmup_shape(parsed: dict) -> bool:
+    return (
+        parsed.get("mode", "committee") == "committee"
+        and (parsed.get("n"), parsed.get("k")) == _WARMUP_SHAPE
+    )
+
+
 def _best_line(stdout_bytes: bytes):
     """Best-throughput success JSON line in the child's output, or
-    (None, last-error-string). The child emits two stages (committee then
+    (None, last-error-string). The child emits staged lines (tiny
+    liveness committee shape, the comparable committee shape, then
     epoch); lines within a stage improve monotonically, so max-value
-    across all lines is the best achieved number — and when both stages
-    landed, each mode's best value is attached so the record shows the
-    committee number AND the epoch number, not just the winner."""
+    across the lines is the best achieved number — except the stage-0
+    4x8 liveness shape, which only becomes the headline when NOTHING
+    else landed (its tiny padded batch posts absurd per-sig rates that
+    would otherwise bury the comparable numbers). Per-shape bests are
+    attached so the record shows the committee AND epoch numbers, not
+    just the winner."""
     err = None
     best = None
+    best_warmup = None
     probes = {}
     mode_best = {}
     for line in stdout_bytes.decode(errors="replace").strip().splitlines():
@@ -256,11 +292,16 @@ def _best_line(stdout_bytes: bytes):
         elif "error" in parsed:
             err = parsed["error"]
         elif parsed.get("value", 0) > 0:
-            if best is None or parsed["value"] > best["value"]:
+            if _is_warmup_shape(parsed):
+                if best_warmup is None or parsed["value"] > best_warmup["value"]:
+                    best_warmup = parsed
+            elif best is None or parsed["value"] > best["value"]:
                 best = parsed
-            mode = parsed.get("mode", "committee")
-            if parsed["value"] > mode_best.get(mode, 0.0):
-                mode_best[mode] = parsed["value"]
+            key = _shape_key(parsed)
+            if parsed["value"] > mode_best.get(key, 0.0):
+                mode_best[key] = parsed["value"]
+    if best is None:
+        best = best_warmup  # only the liveness pre-pass landed
     if best is not None:
         best = dict(best)
         if len(mode_best) > 1:
@@ -354,6 +395,19 @@ def main():
         _emit_result(run_codec_bench())
         return
 
+    if _cli_mode() == "rlc":
+        # final-exp microbench: per-item easy+hard vs the RLC combine on
+        # identical Miller outputs, items/sec across N in {4,16,64,256}.
+        # CPU-forced — the acceptance bar is RLC beating the per-item
+        # path at N >= 16 on plain CPU; RLC_BENCH_* env sizes it
+        from consensus_specs_tpu.utils.jax_env import force_cpu
+
+        force_cpu()
+        from consensus_specs_tpu.bench.rlc_final import run_rlc_bench
+
+        _emit_result(run_rlc_bench())
+        return
+
     if os.environ.get(_CHILD_FLAG) == "1":
         # child: run on the inherited platform, flushing a refreshed JSON
         # line at every stage; a crash/device error becomes a JSON error
@@ -377,7 +431,7 @@ def main():
             # no accelerator plugin resolved — answer fast so the parent's
             # deadline isn't burned on the ~20-min comparable CPU shape
             try:
-                _emit_result(run_workload(override=(4, 8, 1, "committee")))
+                _emit_result(run_workload(override=_WARMUP_OVERRIDE))
             except Exception as e:
                 _emit(0.0, 0.0, error=f"{type(e).__name__}: {e}")
             return
@@ -387,7 +441,7 @@ def main():
             # immediately after any grant (the round-3 "compile + 3 reps
             # < 420 s" proof predates lane folding; the folded committee
             # program's TPU compile time is unmeasured)
-            (4, 8, 1, "committee"),
+            _WARMUP_OVERRIDE,
             (32, 128, 3, "committee"),  # the round-over-round fixed shape
             (0, 0, 1, "epoch"),  # north-star workload; per-rep emission
         ):
@@ -455,8 +509,9 @@ def main():
     force_cpu()
     _, _, _, mode = _workload_params(on_cpu=True)
     if mode == "committee" and not _bench_env_overridden():
-        quick = run_workload(override=(4, 8, 1, "committee"))
-        quick["stage"] = "fallback liveness pre-pass (n=4, k=8)"
+        quick = run_workload(override=_WARMUP_OVERRIDE)
+        quick["stage"] = ("fallback liveness pre-pass "
+                          f"(n={_WARMUP_SHAPE[0]}, k={_WARMUP_SHAPE[1]})")
         if tpu_error is not None:
             quick["platform"] = "cpu (fallback)"
             quick["tpu_error"] = tpu_error[:500]

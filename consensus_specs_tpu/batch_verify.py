@@ -212,7 +212,8 @@ class SignatureCollector:
                 members[u].append(i)
         return order, members
 
-    def flush(self, backend=None, mesh=None, service=None) -> np.ndarray:
+    def flush(self, backend=None, mesh=None, service=None,
+              rlc: bool = False) -> np.ndarray:
         """Verify all recorded checks; returns a bool array in record order.
 
         Identical checks (same kind/pubkeys/message(s)/signature) are
@@ -225,7 +226,16 @@ class SignatureCollector:
         batch pads to its own committee-size bucket (ops/bls_backend.py
         _K_BUCKETS). With ``mesh``, each bucket's batch axis is sharded
         over the mesh (SURVEY §2.7/P1 — the committee axis is the DP
-        axis)."""
+        axis).
+
+        ``rlc=True`` resolves the whole span through the backend's
+        random-linear-combination path (``batch_verify_rlc``): ONE final
+        exponentiation for all recorded checks instead of one per check,
+        with bisection recovering exact per-item verdicts on failure —
+        the epoch-replay bench opts in via CONSENSUS_SPECS_TPU_RLC. Kept
+        opt-in here (unlike the serve plane's default-on) so correctness
+        cross-checks against flush_oracle() keep exercising the per-item
+        device path."""
         out = np.zeros(len(self.checks), dtype=bool)
         order, members = self._unique_checks()
 
@@ -235,6 +245,12 @@ class SignatureCollector:
                     "flush(service=...) uses the service's own backend and "
                     "sharding; pass backend/mesh to the VerificationService "
                     "instead"
+                )
+            if rlc:
+                raise ValueError(
+                    "flush(service=..., rlc=True): the service routes its "
+                    "micro-batches through the RLC path itself "
+                    "(CONSENSUS_SPECS_TPU_RLC governs it)"
                 )
             futures = [
                 service.submit(c.kind, c.pubkeys, c.messages, c.signature)
@@ -246,6 +262,17 @@ class SignatureCollector:
 
         if backend is None:
             from .ops import bls_backend as backend  # noqa: F811
+
+        if rlc:
+            checks = [self.checks[i] for i in order]
+            res = backend.batch_verify_rlc(
+                [(c.kind, c.pubkeys, c.messages, c.signature)
+                 for c in checks],
+                mesh=mesh,
+            )
+            for u, r in enumerate(res):
+                out[members[u]] = bool(r)
+            return out
 
         groups = {}
         for u, i in enumerate(order):

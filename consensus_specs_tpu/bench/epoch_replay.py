@@ -221,6 +221,13 @@ def run_epoch_replay(emit_partial=None) -> dict:
 
     n_sigs = slots * (committees * k_att + k_sync + 1)
 
+    # RLC combine (one final exponentiation for the whole epoch's checks)
+    # is the epoch default; CONSENSUS_SPECS_TPU_RLC=0 reverts to per-item
+    # finalization for A/B
+    from ..ops.bls_backend import rlc_enabled
+
+    rlc = rlc_enabled()
+
     def result(value, **extra):
         out = dict(
             value=value,
@@ -231,6 +238,7 @@ def run_epoch_replay(emit_partial=None) -> dict:
             committees=committees,
             k=k_att,
             signatures=n_sigs,
+            rlc=rlc,
         )
         out.update(extra)
         return out
@@ -241,9 +249,9 @@ def run_epoch_replay(emit_partial=None) -> dict:
     setup_s = time.perf_counter() - t0
 
     # warmup compiles each bucket; its timing (compile-inclusive) is itself
-    # a valid lower bound worth reporting from a short window
+    # a valid lower bound worth reporting if the window dies before rep 1
     t0 = time.perf_counter()
-    ok = col.flush()
+    ok = col.flush(rlc=rlc)
     warm_s = time.perf_counter() - t0
     assert ok.all(), "epoch warmup verification failed"
     if emit_partial is not None:
@@ -259,7 +267,7 @@ def run_epoch_replay(emit_partial=None) -> dict:
     rep_times = []
     for r in range(reps):
         t0 = time.perf_counter()
-        ok = col.flush()
+        ok = col.flush(rlc=rlc)
         dt = time.perf_counter() - t0
         assert ok.all(), "epoch verification failed"
         rep_times.append(dt)

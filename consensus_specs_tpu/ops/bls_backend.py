@@ -114,6 +114,10 @@ def _fold_for(kind: str, k: int, n_items: int = 1 << 30) -> int:
     mostly-filler folded program)."""
     if kind == "hard_part":
         table = 32
+    elif kind == "rlc_combine":
+        # k is the combine's chunk size (f's per instance); a 16-f chunk
+        # already saturates the mul lanes, smaller chunks fold up to it
+        table = max(1, 16 // max(1, k))
     elif kind in _CODEC_FOLDS:
         table = _CODEC_FOLDS[kind]
     elif k <= 160:
@@ -178,6 +182,8 @@ def _program(kind: str, k: int = 0, fold: int = None) -> Tuple[vm.Program, int]:
         prog = vmlib.build_aggregate_verify_miller(k, fold)
     elif kind == "hard_part":
         prog = vmlib.build_hard_part(fold)
+    elif kind == "rlc_combine":
+        prog = vmlib.build_rlc_combine(k, fold)
     elif kind == "g1_subgroup":
         prog = vmlib.build_g1_subgroup_check(fold)
     elif kind == "g2_subgroup":
@@ -613,25 +619,12 @@ def _easy_worker(f_coeffs):
     return np.stack([fq.to_mont_int(c) for c in g])
 
 
-def _easy_part_batch(out, lay, precheck, aggz: bool):
-    """Readback of PROG A outputs + the final-exponentiation easy part for
-    every active item, pooled across processes at epoch scale (the per-item
-    Fq12 inversion/frobenius work is ~1 ms of pure Python each). Returns
-    (g_batch, agg_nonzero | None); degenerate items clear their precheck
-    bit in place."""
-    nb = len(precheck)
-    L = fq.NUM_LIMBS
-    agg_nonzero = np.zeros(nb, dtype=bool) if aggz else None
-    coeffs = {}
-    for i in range(nb):
-        if not precheck[i]:
-            continue
-        r, ns = lay.split(i)
-        if aggz:
-            agg_nonzero[i] = fq.from_mont_limbs(out[f"{ns}aggz"][r]) != 0
-        coeffs[i] = [fq.from_mont_limbs(out[f"{ns}f.{j}"][r]) for j in range(12)]
-
-    results = {}
+def _easy_parts_pooled(coeffs: Dict[int, List[int]]) -> Dict[int, object]:
+    """Easy part for many items (keyed exact coefficient lists), pooled
+    across processes at epoch scale — the per-item Fq12 inversion/frobenius
+    work is ~1 ms of pure Python each. Values are Montgomery g limbs, or
+    None for degenerate f."""
+    results: Dict[int, object] = {}
     items = list(coeffs.items())
     procs = int(
         os.environ.get(
@@ -656,6 +649,27 @@ def _easy_part_batch(out, lay, precheck, aggz: bool):
     if not results:
         for i, c in items:
             results[i] = _easy_worker(c)
+    return results
+
+
+def _easy_part_batch(out, lay, precheck, aggz: bool):
+    """Readback of PROG A outputs + the final-exponentiation easy part for
+    every active item (pooled, _easy_parts_pooled). Returns
+    (g_batch, agg_nonzero | None); degenerate items clear their precheck
+    bit in place."""
+    nb = len(precheck)
+    L = fq.NUM_LIMBS
+    agg_nonzero = np.zeros(nb, dtype=bool) if aggz else None
+    coeffs = {}
+    for i in range(nb):
+        if not precheck[i]:
+            continue
+        r, ns = lay.split(i)
+        if aggz:
+            agg_nonzero[i] = fq.from_mont_limbs(out[f"{ns}aggz"][r]) != 0
+        coeffs[i] = [fq.from_mont_limbs(out[f"{ns}f.{j}"][r]) for j in range(12)]
+
+    results = _easy_parts_pooled(coeffs)
 
     g_batch = np.zeros((nb, 12, L), dtype=np.uint64)
     for i, g in results.items():
@@ -666,9 +680,33 @@ def _easy_part_batch(out, lay, precheck, aggz: bool):
     return g_batch, agg_nonzero
 
 
+def _finalize_per_item(fs: np.ndarray, mesh=None) -> np.ndarray:
+    """(N, 12, L) loose Miller-output rows -> (N,) bool via the PER-ITEM
+    finalization (N pooled easy parts + N hard-part rows) — the exact
+    final-exp pipeline the two batch entry points use, callable on raw f
+    rows so the rlc microbench and the bisection cross-checks race it
+    against the combine path on identical inputs."""
+    n = fs.shape[0]
+    coeffs = {
+        i: [fq.from_mont_limbs(fs[i, j]) for j in range(12)] for i in range(n)
+    }
+    results = _easy_parts_pooled(coeffs)
+    g_batch = np.zeros((n, 12, fq.NUM_LIMBS), dtype=np.uint64)
+    active = np.zeros(n, dtype=bool)
+    for i, g in results.items():
+        if g is not None:
+            g_batch[i] = g
+            active[i] = True
+    ok = _run_hard_part(g_batch, mesh=mesh)
+    return ok & active
+
+
 def _run_hard_part(g_flat_batch: np.ndarray, mesh=None) -> np.ndarray:
-    """(N, 12, L) unitary g limb batch -> (N,) bool (res == 1)."""
+    """(N, 12, L) unitary g limb batch -> (N,) bool (res == 1). Counts N
+    rows (padding included) against RLC_STATS['final_exps'] — the
+    amortization ledger behind the serve plane's final-exps-per-item."""
     n = g_flat_batch.shape[0]
+    RLC_STATS["final_exps"] += n
     lay = _FoldLayout("hard_part", 0, n, mesh)
     L = fq.NUM_LIMBS
     gb = np.zeros((lay.nb, 12, L), dtype=np.uint64)
@@ -694,6 +732,7 @@ def _run_hard_part(g_flat_batch: np.ndarray, mesh=None) -> np.ndarray:
 CALL_COUNTS = {
     "batch_fast_aggregate_verify": 0,
     "batch_aggregate_verify": 0,
+    "batch_verify_rlc": 0,
     "items": 0,
 }
 
@@ -708,27 +747,48 @@ def reset_call_counts() -> None:
         CALL_COUNTS[k] = 0
 
 
-def batch_fast_aggregate_verify(
-    pubkey_sets: Sequence[Sequence[bytes]],
-    messages: Sequence[bytes],
-    signatures: Sequence[bytes],
-    mesh=None,
-) -> np.ndarray:
-    """N independent FastAggregateVerify calls in one device pipeline.
-    This is the TPU mapping of the reference's per-attestation verify loop
-    (reference specs/phase0/beacon-chain.md:1742-1756, :719-735).
-    With ``mesh``, the batch axis is sharded over its first mesh axis."""
+# RLC-plane observability: how many combine programs ran, how many failed
+# combined checks forced a bisection split, and how many hard-part
+# evaluations (device rows, padding included, + host-oracle hard parts)
+# the process has paid — final_exps / items is the amortization headline
+# the serve bench reports as final-exps-per-item
+RLC_STATS = {
+    "combines": 0,
+    "bisections": 0,
+    "final_exps": 0,
+    "items": 0,
+}
+
+
+def _export_rlc_gauges() -> None:
+    from . import profiling
+
+    profiling.set_gauge("bls.rlc_combines", RLC_STATS["combines"])
+    profiling.set_gauge("bls.rlc_bisections", RLC_STATS["bisections"])
+    profiling.set_gauge("bls.final_exps", RLC_STATS["final_exps"])
+
+
+def reset_rlc_stats() -> None:
+    for k in RLC_STATS:
+        RLC_STATS[k] = 0
+    _export_rlc_gauges()
+
+
+def _miller_fast_aggregate(
+    pubkey_sets, messages, signatures, mesh=None
+) -> Tuple[Optional[dict], "_FoldLayout", np.ndarray]:
+    """PROG A stage of batch_fast_aggregate_verify: host prep + the
+    aggregate-and-Miller program. Returns (out, lay, precheck); ``out`` is
+    None when no item survived host prep (then only precheck matters).
+    Split out so the RLC combine path (batch_verify_rlc) can share the
+    Miller stage and swap just the finalization."""
     n = len(pubkey_sets)
-    assert len(messages) == n and len(signatures) == n
-    _count_call("batch_fast_aggregate_verify", n)
-    if n == 0:
-        return np.zeros(0, dtype=bool)
     max_k = max((len(pks) for pks in pubkey_sets), default=1)
     k = _k_bucket(max(1, max_k))
     L = fq.NUM_LIMBS
 
     lay = _FoldLayout("miller_product", k, n, mesh)
-    prA, fold, rows, nb = lay.program, lay.fold, lay.rows, lay.nb
+    prA, rows, nb = lay.program, lay.rows, lay.nb
     prewarm_host_caches(
         [bytes(m) for m in messages],
         [bytes(s) for s in signatures],
@@ -766,7 +826,7 @@ def batch_fast_aggregate_verify(
         precheck[i] = True
 
     if not precheck.any():
-        return precheck[:n]
+        return None, lay, precheck
 
     ins = {}
     lay.scatter(ins, pk_x, lambda j: f"pk{j}.x")
@@ -776,26 +836,40 @@ def batch_fast_aggregate_verify(
     lay.scatter(ins, sg, lambda ci: f"sig.{_G2_COMPS[ci]}")
 
     out = vm.execute(prA, ins, batch_shape=(rows,), mesh=mesh)
+    return out, lay, precheck
 
+
+def batch_fast_aggregate_verify(
+    pubkey_sets: Sequence[Sequence[bytes]],
+    messages: Sequence[bytes],
+    signatures: Sequence[bytes],
+    mesh=None,
+) -> np.ndarray:
+    """N independent FastAggregateVerify calls in one device pipeline.
+    This is the TPU mapping of the reference's per-attestation verify loop
+    (reference specs/phase0/beacon-chain.md:1742-1756, :719-735).
+    With ``mesh``, the batch axis is sharded over its first mesh axis."""
+    n = len(pubkey_sets)
+    assert len(messages) == n and len(signatures) == n
+    _count_call("batch_fast_aggregate_verify", n)
+    if n == 0:
+        return np.zeros(0, dtype=bool)
+    out, lay, precheck = _miller_fast_aggregate(
+        pubkey_sets, messages, signatures, mesh
+    )
+    if out is None:
+        return precheck[:n]
     g_batch, agg_nonzero = _easy_part_batch(out, lay, precheck, aggz=True)
     ok = _run_hard_part(g_batch, mesh=mesh)
     return (ok & precheck & agg_nonzero)[:n]
 
 
-def batch_aggregate_verify(
-    pubkey_lists: Sequence[Sequence[bytes]],
-    message_lists: Sequence[Sequence[bytes]],
-    signatures: Sequence[bytes],
-    mesh=None,
-) -> np.ndarray:
-    """N independent AggregateVerify calls (distinct messages per pubkey).
-    Inactive pair lanes use infinity G1 (their Miller factor lands in a
-    proper subfield, killed by the final exponentiation).
-    With ``mesh``, the batch axis is sharded over its first mesh axis."""
+def _miller_aggregate(
+    pubkey_lists, message_lists, signatures, mesh=None
+) -> Tuple[Optional[dict], "_FoldLayout", np.ndarray]:
+    """PROG A stage of batch_aggregate_verify (distinct message per pubkey);
+    same contract as _miller_fast_aggregate."""
     n = len(pubkey_lists)
-    _count_call("batch_aggregate_verify", n)
-    if n == 0:
-        return np.zeros(0, dtype=bool)
     max_k = max(
         (len(pks) for pks in pubkey_lists), default=1
     )
@@ -803,7 +877,7 @@ def batch_aggregate_verify(
     L = fq.NUM_LIMBS
 
     lay = _FoldLayout("aggregate_verify", k, n, mesh)
-    prA, fold, rows, nb = lay.program, lay.fold, lay.rows, lay.nb
+    prA, rows, nb = lay.program, lay.rows, lay.nb
     prewarm_host_caches(
         [bytes(m) for ms in message_lists for m in ms],
         [bytes(s) for s in signatures],
@@ -840,7 +914,7 @@ def batch_aggregate_verify(
         precheck[i] = True
 
     if not precheck.any():
-        return precheck[:n]
+        return None, lay, precheck
 
     ins = {}
     lay.scatter(ins, pk_x, lambda j: f"pk{j}.x")
@@ -850,9 +924,291 @@ def batch_aggregate_verify(
     lay.scatter(ins, sg, lambda ci: f"sig.{_G2_COMPS[ci]}")
 
     out = vm.execute(prA, ins, batch_shape=(rows,), mesh=mesh)
+    return out, lay, precheck
+
+
+def batch_aggregate_verify(
+    pubkey_lists: Sequence[Sequence[bytes]],
+    message_lists: Sequence[Sequence[bytes]],
+    signatures: Sequence[bytes],
+    mesh=None,
+) -> np.ndarray:
+    """N independent AggregateVerify calls (distinct messages per pubkey).
+    Inactive pair lanes use infinity G1 (their Miller factor lands in a
+    proper subfield, killed by the final exponentiation).
+    With ``mesh``, the batch axis is sharded over its first mesh axis."""
+    n = len(pubkey_lists)
+    _count_call("batch_aggregate_verify", n)
+    if n == 0:
+        return np.zeros(0, dtype=bool)
+    out, lay, precheck = _miller_aggregate(
+        pubkey_lists, message_lists, signatures, mesh
+    )
+    if out is None:
+        return precheck[:n]
     g_batch, _ = _easy_part_batch(out, lay, precheck, aggz=False)
     ok = _run_hard_part(g_batch, mesh=mesh)
     return (ok & precheck)[:n]
+
+
+# ---------------------------------------------------------------------------
+# RLC batch verification: one final exponentiation per micro-batch
+# ---------------------------------------------------------------------------
+
+
+def rlc_enabled() -> bool:
+    """Serve-plane default: micro-batches ride the RLC path unless
+    CONSENSUS_SPECS_TPU_RLC=0 reverts to per-item final exponentiation."""
+    return os.environ.get("CONSENSUS_SPECS_TPU_RLC", "1") != "0"
+
+
+def _rlc_backend() -> str:
+    """Combine-stage backend: 'vm' (the lane-scheduled device program,
+    default) or 'jax' (ops/pairing.rlc_combine — the non-VM path, also the
+    oracle cross-check's subject)."""
+    v = os.environ.get("CONSENSUS_SPECS_TPU_RLC_BACKEND", "vm")
+    return v if v == "jax" else "vm"
+
+
+def _rlc_chunk_max() -> int:
+    """f's combined per VM program instance. 16 saturates the mul lanes;
+    bigger batches run more chunk rows and host-multiply the chunk
+    products (each a single oracle Fq12 mul). Env-tunable so tests can
+    exercise multi-chunk batching with small, fast-to-assemble programs."""
+    return max(1, int(os.environ.get("CONSENSUS_SPECS_TPU_RLC_CHUNK", "16")))
+
+
+def _rlc_final_mode() -> str:
+    """Where the ONE combined hard part runs: 'device' (a hard_part VM
+    row) or 'host' (exact-int oracle HHT). 'auto' (default) picks host on
+    plain CPU — a lone fold-1 hard-part row is depth-bound (~4.9k serial
+    steps, ~1.3 s of XLA-CPU time) while the oracle does one element in
+    ~20 ms — and device under an accelerator, where the row is the cheap
+    option. Both are exact; tests pin them bit-identical."""
+    v = os.environ.get("CONSENSUS_SPECS_TPU_RLC_FINAL", "auto")
+    if v in ("host", "device"):
+        return v
+    try:
+        import jax
+
+        return "host" if jax.default_backend() == "cpu" else "device"
+    except Exception:
+        return "host"
+
+
+def _rlc_scalars(m: int, rng=None) -> np.ndarray:
+    """(m, RLC_BITS) uint8 msb-first bit matrix of m fresh NONZERO random
+    scalars — from ``rng.getrandbits`` when injected (deterministic
+    tests), else os.urandom."""
+    nbits = vmlib.RLC_BITS
+    bits = np.zeros((m, nbits), dtype=np.uint8)
+    for i in range(m):
+        r = 0
+        while r == 0:
+            if rng is not None:
+                r = rng.getrandbits(nbits)
+            else:
+                r = int.from_bytes(os.urandom(nbits // 8), "big")
+        for t in range(nbits):
+            bits[i, t] = (r >> (nbits - 1 - t)) & 1
+    return bits
+
+
+def _oracle_unitary_pow_abs(g, bits):
+    acc = g
+    for b in bits[1:]:
+        acc = acc * acc
+        if b:
+            acc = acc * g
+    return acc
+
+
+def _hard_part_is_one_oracle(g_coeffs: List[int]) -> bool:
+    """Exact-int HHT hard part on a unitary g (the host twin of PROG B,
+    same decomposition as vmlib.build_hard_part; inverse == conjugate in
+    the cyclotomic subgroup). ~20 ms per element — the right tool for the
+    ONE combined element on CPU."""
+    RLC_STATS["final_exps"] += 1
+    g = _flat_ints_to_oracle(g_coeffs)
+    px = lambda t: _oracle_unitary_pow_abs(t, vmlib.ABS_X_BITS).conjugate()
+    px1 = lambda t: _oracle_unitary_pow_abs(
+        t, vmlib.ABS_X_PLUS_1_BITS
+    ).conjugate()
+    t0 = px1(px1(g))
+    t1 = px(t0) * t0.frobenius()
+    t2 = px(px(t1))
+    t2 = t2 * t1.frobenius().frobenius()
+    t2 = t2 * t1.conjugate()
+    res = t2 * (g * g * g)
+    return _oracle_to_flat_ints(res) == [1] + [0] * 11
+
+
+def _final_exp_is_one(f_coeffs: List[int], mesh=None) -> bool:
+    """ONE full final exponentiation on exact coefficients: the shared
+    host easy part, then the hard part per _rlc_final_mode()."""
+    g = _easy_part_flat(f_coeffs)
+    if g is None:
+        return False  # degenerate f: no valid item produces it
+    if _rlc_final_mode() == "host":
+        return _hard_part_is_one_oracle(g)
+    gm = np.stack([fq.to_mont_int(c) for c in g])
+    return bool(_run_hard_part(gm[None], mesh=mesh)[0])
+
+
+def _rlc_combine_vm(fs: np.ndarray, bits: np.ndarray, mesh=None) -> List[int]:
+    """Combine via the VM program: chunk the (m, 12, L) f batch into
+    rlc_combine instances, execute one batched program, multiply the
+    per-chunk products on host (one oracle Fq12 mul each). Returns the
+    exact flat coefficients of prod f_i^{r_i}."""
+    m = fs.shape[0]
+    chunk = min(_pow2(m), _rlc_chunk_max())
+    n_chunks = -(-m // chunk)
+    lay = _FoldLayout("rlc_combine", chunk, n_chunks, mesh)
+    L = fq.NUM_LIMBS
+    fb = np.zeros((lay.nb, chunk, 12, L), dtype=np.uint64)
+    fb[:, :, 0] = _ONE_LIMBS  # inactive lanes: f = 1, bits = 0 -> 1^0
+    rb = np.zeros((lay.nb, chunk, vmlib.RLC_BITS, L), dtype=np.uint64)
+    fb.reshape(lay.nb * chunk, 12, L)[:m] = fs
+    rb.reshape(lay.nb * chunk, vmlib.RLC_BITS, L)[:m] = np.where(
+        bits[..., None].astype(bool), _ONE_LIMBS, np.uint64(0)
+    )
+    ins = {}
+    lay.scatter(ins, fb, lambda i, j: f"f{i}.{j}")
+    lay.scatter(ins, rb, lambda i, t: f"r{i}.{t}")
+    out = vm.execute(lay.program, ins, batch_shape=(lay.rows,), mesh=mesh)
+    total = None
+    for c in range(n_chunks):
+        r, ns = lay.split(c)
+        x = _flat_ints_to_oracle(
+            [fq.from_mont_limbs(out[f"{ns}c.{j}"][r]) for j in range(12)]
+        )
+        total = x if total is None else total * x
+    return _oracle_to_flat_ints(total)
+
+
+def _rlc_combine_jax(fs: np.ndarray, bits: np.ndarray) -> List[int]:
+    from . import pairing
+
+    c = np.asarray(pairing.rlc_combine(fs, bits.astype(bool)))
+    return [fq.from_mont_limbs(c[j]) for j in range(12)]
+
+
+def batch_verify_rlc(items, mesh=None, rng=None) -> np.ndarray:
+    """N independent verifications decided by random-linear-combination:
+    check prod_i f_i^{r_i} == 1 (post final exp) for fresh random nonzero
+    128-bit scalars r_i, so the whole micro-batch pays ONE easy part and
+    ONE hard part instead of N of each (blst mult_verify's trick; the
+    amortization lever of arXiv:2302.00418).
+
+    ``items``: sequence of (kind, pubkeys, messages, signature) with kind
+    'fast_aggregate' (one message) or 'aggregate' (per-key messages) —
+    the serve plane's micro-batch shape. Items are grouped by
+    (kind, K-bucket) for PROG A exactly like SignatureCollector.flush,
+    and the Miller outputs feed the combine program as raw loose limbs
+    (no per-item host canonicalization or easy part).
+
+    Soundness (Schwartz-Zippel): the final-exp images f_i^E live in the
+    order-r subgroup, r prime ~2^255. The combined check is
+    g^(sum a_i r_i) == 1 for f_i^E = g^{a_i}; if any a_i != 0, at most
+    one value of that r_i (mod r) zeroes the sum, so a batch containing
+    any invalid item passes with probability <= 2^-128 over the fresh
+    per-combine scalars (drawn from os.urandom; ``rng`` — anything with
+    getrandbits — overrides for deterministic tests). False REJECTION is
+    impossible: all-valid batches have every a_i = 0.
+
+    A failed combined check falls back to bisection: split the candidate
+    list, re-combine each half with fresh scalars, recurse — exact
+    per-item finalization at singletons — so callers always get exact
+    per-item verdicts with O(log N * #bad) extra combines. A batch of 1
+    (or 1 surviving candidate) degenerates to the plain per-item path
+    with no combine at all. Verdicts are bit-identical to
+    batch_fast_aggregate_verify / batch_aggregate_verify on every input
+    (up to the 2^-128 bound, which no test will ever see)."""
+    items = list(items)
+    n = len(items)
+    _count_call("batch_verify_rlc", n)
+    if n == 0:
+        return np.zeros(0, dtype=bool)
+    verdict = np.zeros(n, dtype=bool)
+
+    groups: Dict[Tuple[str, int], List[int]] = {}
+    for i, (kind, pks, _msgs, _sig) in enumerate(items):
+        if kind not in ("fast_aggregate", "aggregate"):
+            raise ValueError(f"unknown check kind {kind!r}")
+        groups.setdefault((kind, _k_bucket(max(1, len(pks)))), []).append(i)
+
+    # PROG A per (kind, bucket) group; gather surviving candidates' Miller
+    # outputs as raw limb rows (host precheck / infinite-aggregate
+    # failures are False without any finalization work)
+    cand_idx: List[int] = []
+    fs_rows: List[np.ndarray] = []
+    for (kind, _bucket), idxs in groups.items():
+        sub = [items[i] for i in idxs]
+        if kind == "fast_aggregate":
+            out, lay, precheck = _miller_fast_aggregate(
+                [it[1] for it in sub], [it[2] for it in sub],
+                [it[3] for it in sub], mesh,
+            )
+        else:
+            out, lay, precheck = _miller_aggregate(
+                [it[1] for it in sub], [it[2] for it in sub],
+                [it[3] for it in sub], mesh,
+            )
+        if out is None:
+            continue
+        for pos, i in enumerate(idxs):
+            if not precheck[pos]:
+                continue
+            r, ns = lay.split(pos)
+            if kind == "fast_aggregate" and (
+                fq.from_mont_limbs(out[f"{ns}aggz"][r]) == 0
+            ):
+                continue  # aggregate pubkey is infinity: False, no crypto
+            fs_rows.append(
+                np.stack([out[f"{ns}f.{j}"][r] for j in range(12)])
+            )
+            cand_idx.append(i)
+
+    m = len(cand_idx)
+    RLC_STATS["items"] += m
+    if m == 0:
+        _export_rlc_gauges()
+        return verdict
+    fs = np.stack(fs_rows)  # (m, 12, L), loose limbs straight from PROG A
+
+    def finalize_item(j: int) -> bool:
+        coeffs = [fq.from_mont_limbs(fs[j, c]) for c in range(12)]
+        return _final_exp_is_one(coeffs, mesh=mesh)
+
+    def combine_check(sel: List[int]) -> bool:
+        RLC_STATS["combines"] += 1
+        bits = _rlc_scalars(len(sel), rng)
+        sub = fs[np.asarray(sel)]
+        if _rlc_backend() == "jax":
+            coeffs = _rlc_combine_jax(sub, bits)
+        else:
+            coeffs = _rlc_combine_vm(sub, bits, mesh)
+        return _final_exp_is_one(coeffs, mesh=mesh)
+
+    def resolve(sel: List[int]) -> None:
+        if len(sel) == 1:
+            verdict[cand_idx[sel[0]]] = finalize_item(sel[0])
+            return
+        if combine_check(sel):
+            for j in sel:
+                verdict[cand_idx[j]] = True
+            return
+        RLC_STATS["bisections"] += 1
+        mid = len(sel) // 2
+        resolve(sel[:mid])
+        resolve(sel[mid:])
+
+    if m == 1:
+        verdict[cand_idx[0]] = finalize_item(0)  # plain-path degeneration
+    else:
+        resolve(list(range(m)))
+    _export_rlc_gauges()
+    return verdict
 
 
 # ---------------------------------------------------------------------------

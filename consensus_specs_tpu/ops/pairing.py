@@ -187,6 +187,38 @@ def final_exp_is_one(f):
     return tw.fq12_is_one(res)
 
 
+def rlc_combine(fs, rs_bits):
+    """Random-linear-combination combine: prod_i f_i^{r_i} as ONE Fq12.
+
+    fs: (N, 12, L) flat Fq12 batch (loose Montgomery limbs);
+    rs_bits: (N, B) bool/int exponent bits, msb-first.
+    Returns (12, L). The per-item ladder is the branchless
+    square-and-multiply scan of ``_pow_fixed``, but the bits are RUNTIME
+    inputs (selected per item per step with ``fq12_select``) instead of a
+    static schedule; the powered values then tree-reduce pairwise into one
+    element. This is the jax twin of the VM program
+    ``vmlib.build_rlc_combine`` (non-VM backend + oracle cross-check)."""
+    fs = jnp.asarray(fs)
+    n = fs.shape[0]
+    bits = jnp.asarray(rs_bits, dtype=bool).T  # (B, N) for the scan
+    ident = tw.fq12_one((n,))
+
+    def body(acc, bit_col):
+        acc = tw.fq12_square(acc)
+        sel = tw.fq12_select(bit_col, fs, ident)
+        return tw.fq12_mul(acc, sel), None
+
+    acc, _ = jax.lax.scan(body, ident, bits)
+    # log-depth pairwise tree reduce of the N powered values
+    while acc.shape[0] > 1:
+        m = acc.shape[0] // 2
+        head = tw.fq12_mul(acc[: 2 * m : 2], acc[1 : 2 * m : 2])
+        acc = head if acc.shape[0] % 2 == 0 else jnp.concatenate(
+            [head, acc[-1:]], axis=0
+        )
+    return acc[0]
+
+
 def pairing_product_is_one(pairs):
     """prod e(P_i, Q_i) == 1 for a list of (px, py, qx, qy) batched coords."""
     f = None

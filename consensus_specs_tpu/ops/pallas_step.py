@@ -26,8 +26,11 @@ shift — else rhs = b; out = carry(a + rhs) over 31 rows keeping 30
 (== value mod 2^420, the same top-limb drop as fq's 16-keep-15).
 Bit-identical to the u64 path (tests/test_ops_pallas_step.py).
 
-Enable via CONSENSUS_SPECS_TPU_PALLAS=step (vm.py dispatch; single-device
-path only — under a mesh the scan body must stay GSPMD-partitionable).
+Enable via CONSENSUS_SPECS_TPU_PALLAS=step (vm.py dispatch). Runs under a
+device mesh too: a pallas_call is opaque to the GSPMD partitioner, so the
+mesh runner routes modes '1'/'step' through jax.shard_map (each device
+traces its own per-shard program — vm._vm_run_for_mesh); only GSPMD
+sharding is mode-'0'-specific.
 """
 import functools
 import os

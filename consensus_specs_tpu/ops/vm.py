@@ -90,18 +90,42 @@ class Prog:
         self.output_names: List[str] = []
         self._one: Optional[Val] = None
         self._compressed: Dict[int, int] = {}  # op idx -> compressed op idx
+        self._cse: Dict[Tuple[int, int, int], int] = {}  # (kind,a,b) -> op idx
 
     # -- value creation ----------------------------------------------------
 
     def _push(self, kind, a, b, bound) -> Val:
+        """Create an ALU op, CSE-deduplicated. The dedup matters beyond op
+        count: formula code that re-derives the same subexpression against a
+        LOOP-INVARIANT operand (e.g. the Karatsuba half-sums of a constant
+        multiplicand inside an exponentiation ladder) would otherwise emit
+        input-ready ops the greedy scheduler places at step ~0, whose values
+        then sit live until their distant consumer — measured as a 10x
+        register-file blowup (and per-step cost is dominated by register-file
+        gather/scatter traffic). Bounds are a pure function of (kind, operand
+        bounds), so the memoized op is exact."""
+        if a >= 0 and b >= 0:  # inputs/consts use -1 sentinels: never CSE
+            key = (kind, a, b) if (kind == _SUB or a <= b) else (kind, b, a)
+            hit = self._cse.get(key)
+            if hit is not None:
+                return Val(self, hit)
+        else:
+            key = None
         if bound >= _B_CAP:
             raise AssertionError("assembler bound overflow — missing compress")
         self.ops.append(_Op(kind, a, b, bound))
-        return Val(self, len(self.ops) - 1)
+        v = Val(self, len(self.ops) - 1)
+        if key is not None:
+            self._cse[key] = v.idx
+        return v
 
-    def inp(self, name: str) -> Val:
-        """Runtime input slot (canonical Montgomery residue, < p)."""
-        v = self._push(_MUL, -1, -1, fq.P)
+    def inp(self, name: str, bound: int = fq.P) -> Val:
+        """Runtime input slot. Default ``bound`` declares a canonical
+        Montgomery residue (< p); pass a looser bound (e.g. 1 << 382) when
+        the input is another program's compressed OUTPUT fed back in without
+        host-side canonicalization — the bound tracker then inserts the
+        compress multiplies the looser magnitude needs."""
+        v = self._push(_MUL, -1, -1, bound)
         self.ops[v.idx].kind = -1  # input marker
         self.inputs.append(v.idx)
         self.input_names.append(name)
@@ -435,10 +459,12 @@ def _vm_body(inputs_u32, template, input_regs, output_regs, instr,
     (which is tens of times larger at epoch scale).
 
     ``pallas_mode`` (STATIC jit argument — set by execute() from
-    CONSENSUS_SPECS_TPU_PALLAS on the single-device path only; a
-    pallas_call is not GSPMD-partitionable, so the mesh runner is always
-    '0'). Making it static keys the jit cache per mode — an env flip can
-    never alias a cached executable of a different dispatch:
+    CONSENSUS_SPECS_TPU_PALLAS on both the single-device and mesh paths;
+    a pallas_call is opaque to the GSPMD partitioner, so under a mesh the
+    Pallas modes route through shard_map — see _vm_run_for_mesh — and only
+    the GSPMD-sharding fast path is mode-'0'-specific). Making it static
+    keys the jit cache per mode — an env flip can never alias a cached
+    executable of a different dispatch:
       '0'    — jnp u64 lowering for both units (default);
       '1'    — Pallas mont_mul kernel, LIN unit stays XLA;
       'step' — the whole scan on a 14-bit uint32 register file through
@@ -507,15 +533,27 @@ def _vm_run_for_mesh(mesh, pallas_mode="0"):
 
     spec_b = P(mesh.axis_names)
     repl = P()
-    body = jax.shard_map(
+    # a pallas_call's outputs carry no varying-mesh-axes metadata for the
+    # vma/replication checker; the body is batch-elementwise so the manual
+    # partition is trivially consistent. jax < 0.5 ships shard_map under
+    # jax.experimental, and the checker flag was renamed check_rep ->
+    # check_vma later still — so detect the kwarg, not just the attribute.
+    import inspect
+
+    if hasattr(jax, "shard_map"):
+        shard_map_fn = jax.shard_map
+    else:
+        from jax.experimental.shard_map import shard_map as shard_map_fn
+    if "check_vma" in inspect.signature(shard_map_fn).parameters:
+        check_kw = {"check_vma": False}
+    else:
+        check_kw = {"check_rep": False}
+    body = shard_map_fn(
         lambda i, t, ir, o, ins: _vm_body(i, t, ir, o, ins, pallas_mode),
         mesh=mesh,
         in_specs=(spec_b, repl, repl, repl, tuple(repl for _ in range(7))),
         out_specs=spec_b,
-        # a pallas_call's outputs carry no varying-mesh-axes metadata for
-        # the vma checker; the body is batch-elementwise so the manual
-        # partition is trivially consistent
-        check_vma=False,
+        **check_kw,
     )
     return jax.jit(body)
 

@@ -826,6 +826,87 @@ def build_h2g_finish(fold: int = 1) -> Prog:
     return prog
 
 
+# ---------------------------------------------------------------------------
+# RLC combine (random-linear-combination batch verification)
+# ---------------------------------------------------------------------------
+
+# RLC scalar width: fresh ~128-bit exponents give a 2^-128 Schwartz-Zippel
+# false-accept bound (ops/bls_backend.batch_verify_rlc docstring)
+RLC_BITS = 128
+
+# PROG A outputs are compressed but LOOSE (< 2^382, not < p); declaring the
+# true magnitude lets the bound tracker insert the compresses this needs,
+# and the host can then feed f straight from the PROG A readback with no
+# per-item int canonicalization
+RLC_F_BOUND = 1 << 382
+
+
+def _emit_rlc_combine(prog: Prog, ns: str, n: int) -> None:
+    """prod_i f_i^{r_i} for RUNTIME exponent bits — the square-and-multiply
+    ladder of pairing._pow_fixed, but with the bits as inputs instead of
+    constants. The conditional multiply is arithmetic, not a select:
+
+        acc' = acc^2 * (1 + b*(f-1)) = acc^2 + b * (acc^2 * (f-1))
+
+    i.e. square, dense-multiply by the loop-invariant (f-1), scale the 12
+    coefficients by the bit, add back — every op CHAINS on the accumulator,
+    so the greedy scheduler keeps live ranges short (the select form's
+    input-ready multiplies all landed at step ~0 and sat live for thousands
+    of steps, a measured 10x register-file blowup). The n ladders are
+    emitted LEVEL-INTERLEAVED (bit t of every item before bit t+1 of any)
+    so they advance in lockstep through the mul lanes, then a log-depth
+    tree reduce multiplies the powered values into one Fq12."""
+    one = prog.const(1)
+    fm1s: List[List[Val]] = []
+    bitss: List[List[Val]] = []
+    for i in range(n):
+        fc = [prog.inp(f"{ns}f{i}.{j}", bound=RLC_F_BOUND) for j in range(12)]
+        # f - 1 in the flat w-basis differs from f only at coefficient 0
+        fm1s.append([fc[0] - one] + fc[1:])
+        bitss.append([prog.inp(f"{ns}r{i}.{t}") for t in range(RLC_BITS)])
+    # first bit from acc = 1: acc = 1 + b*(f-1), the cheap 12-mul form
+    accs = [
+        [(bitss[i][0] * fm1s[i][0]) + one]
+        + [bitss[i][0] * fm1s[i][j] for j in range(1, 12)]
+        for i in range(n)
+    ]
+    for t in range(1, RLC_BITS):
+        for i in range(n):
+            s = f12_square(prog, accs[i])
+            m = f12_mul(prog, s, fm1s[i])
+            b = bitss[i][t]
+            accs[i] = [s[j] + (b * m[j]) for j in range(12)]
+    powered = accs
+    while len(powered) > 1:
+        nxt = [
+            f12_mul(prog, powered[i], powered[i + 1])
+            for i in range(0, len(powered) - 1, 2)
+        ]
+        if len(powered) % 2:
+            nxt.append(powered[-1])
+        powered = nxt
+    for j in range(12):
+        prog.out(powered[0][j], f"{ns}c.{j}")
+
+
+def build_rlc_combine(n: int, fold: int = 1) -> Prog:
+    """RLC combine program: prod_{i<n} f_i^{r_i} into ONE Fq12.
+
+    Inputs per instance: f{i}.0..f{i}.11 (flat Fq12, LOOSE limbs accepted —
+    feed PROG A outputs directly) and r{i}.0..r{i}.{RLC_BITS-1} (the
+    exponent bits msb-first, each the canonical residue of 0 or 1).
+    Outputs c.0..c.11. Inactive lanes pass f = 1 with all-zero bits (then
+    f^r = 1, the product's identity). ``fold`` packs that many independent
+    combines per program row, as in build_miller_product."""
+    prog = Prog()
+    if fold == 1:
+        _emit_rlc_combine(prog, "", n)
+    else:
+        for t in range(fold):
+            _emit_rlc_combine(prog, f"i{t}.", n)
+    return prog
+
+
 def _emit_hard_part(prog: Prog, ns: str) -> None:
     g = [prog.inp(f"{ns}g.{i}") for i in range(12)]
 

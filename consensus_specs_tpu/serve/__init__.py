@@ -1,9 +1,11 @@
 """Serve plane: continuous micro-batching ingress for the BLS backend.
 
 Turns the offline collect-then-flush verification plane into a live
-streaming service: bounded ingress queue -> (kind, K-bucket) grouped
-micro-batches (flush on size OR deadline) -> batched device verification
-with oracle fallback -> content-keyed result cache + in-flight dedup.
+streaming service: bounded ingress queue -> micro-batches (flush on size
+OR deadline) -> ONE RLC combined check per flush (batch_verify_rlc;
+CONSENSUS_SPECS_TPU_RLC=0 reverts to (kind, K-bucket) grouped batched
+calls, the fallback ladder either way ending at the pure-Python oracle)
+-> content-keyed result cache + in-flight dedup.
 See service.py for the dataflow and COMPONENTS.md's "Serve plane" row.
 """
 from .cache import ResultCache, check_key  # noqa: F401

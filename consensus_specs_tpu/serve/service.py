@@ -35,6 +35,7 @@ context — the default fallback oracle is captured from the bls
 switchboard at __init__ time, and inside a collector those names are the
 recording interceptors.
 """
+import os
 import queue
 import threading
 import time
@@ -47,6 +48,15 @@ from .cache import ResultCache, check_key
 from .metrics import ServeMetrics
 
 KINDS = ("fast_aggregate", "aggregate")
+
+
+def _rlc_enabled() -> bool:
+    """Micro-batches route through the backend's RLC combine path (one
+    final exponentiation per flush) unless CONSENSUS_SPECS_TPU_RLC=0
+    reverts to per-(kind, K-bucket) per-item finalization. Same env the
+    backend's own rlc_enabled() reads — checked here so custom/test
+    backends without batch_verify_rlc never import the real one."""
+    return os.environ.get("CONSENSUS_SPECS_TPU_RLC", "1") != "0"
 
 
 class ServiceClosed(RuntimeError):
@@ -348,18 +358,55 @@ class VerificationService:
         for p in batch:
             groups.setdefault((p.kind, p.bucket), []).append(p)
         t_flush = time.perf_counter()
-        for (kind, bucket), pends in groups.items():
-            t0 = time.perf_counter()
-            results = self._verify_group(kind, pends)
-            self.metrics.note_batch(
-                len(pends), sum(len(p.pubkeys) for p in pends), bucket,
-                time.perf_counter() - t0,
-            )
-            self._settle(pends, results)
+        results = self._verify_rlc(batch)
+        if results is not None:
+            # ONE combined check decided the whole micro-batch; attribute
+            # the flush time to its (kind, K-bucket) groups by item share
+            # so occupancy/batch accounting stays per-group
+            dt = time.perf_counter() - t_flush
+            for (kind, bucket), pends in groups.items():
+                self.metrics.note_batch(
+                    len(pends), sum(len(p.pubkeys) for p in pends), bucket,
+                    dt * len(pends) / len(batch),
+                )
+            self._settle(batch, results)
+        else:
+            for (kind, bucket), pends in groups.items():
+                t0 = time.perf_counter()
+                results = self._verify_group(kind, pends)
+                self.metrics.note_batch(
+                    len(pends), sum(len(p.pubkeys) for p in pends), bucket,
+                    time.perf_counter() - t0,
+                )
+                self._settle(pends, results)
         # whole-flush device time (all groups): the prep/device split is
         # per FLUSH on both sides, so the means share a denominator shape
         self.metrics.note_device_flush(time.perf_counter() - t_flush)
         self.metrics.export_gauges()
+
+    def _verify_rlc(self, batch: List[_Pending]) -> Optional[List[bool]]:
+        """Whole-micro-batch RLC verification (backend.batch_verify_rlc:
+        one easy part + one hard part for the flush, bisection localizes
+        failures). Returns None to fall back to the per-group path — when
+        the env reverts it, the backend has no RLC entry point, or every
+        bounded retry failed (the per-group path then brings its own
+        retry-then-oracle ladder, so an RLC-specific fault — e.g. a
+        combine-program compile error — still degrades in two steps
+        instead of straight to the sequential oracle)."""
+        backend = self._resolve_backend()
+        rlc_fn = getattr(backend, "batch_verify_rlc", None)
+        if rlc_fn is None or not _rlc_enabled():
+            return None
+        items = [(p.kind, p.pubkeys, p.messages, p.signature) for p in batch]
+        for attempt in range(1 + self._backend_retries):
+            if attempt:
+                self.metrics.note_retry()
+            try:
+                return [bool(r) for r in rlc_fn(items)]
+            except Exception:
+                pass
+        profiling.record("serve.rlc_error", 0.0)
+        return None
 
     def _verify_group(self, kind: str, pends: List[_Pending]) -> List[bool]:
         backend = self._resolve_backend()

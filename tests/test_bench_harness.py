@@ -47,6 +47,33 @@ def test_best_line_single_mode_has_no_per_mode_key(bench):
     assert "per_mode_best" not in best
 
 
+def test_best_line_warmup_shape_never_shadows_comparable(bench):
+    """ADVICE round 5: the stage-0 4x8 liveness shape posts absurd
+    per-sig rates; it must not become the headline OR occupy the
+    committee slot of per_mode_best when a comparable shape landed."""
+    best, err = bench._best_line(_lines(
+        {"value": 9000.0, "mode": "committee", "n": 4, "k": 8},
+        {"value": 310.0, "mode": "committee", "n": 32, "k": 128},
+        {"value": 250.0, "mode": "epoch"},
+    ))
+    assert err is None
+    assert best["value"] == 310.0 and (best["n"], best["k"]) == (32, 128)
+    assert best["per_mode_best"] == {
+        "committee[4x8]": 9000.0,
+        "committee[32x128]": 310.0,
+        "epoch": 250.0,
+    }
+
+
+def test_best_line_warmup_shape_used_when_alone(bench):
+    """A window that only landed the liveness pre-pass still records it
+    (better a tiny-shape number than none)."""
+    best, _ = bench._best_line(_lines(
+        {"value": 9000.0, "mode": "committee", "n": 4, "k": 8},
+    ))
+    assert best["value"] == 9000.0
+
+
 def test_best_line_attaches_probes_and_surfaces_error(bench):
     best, err = bench._best_line(_lines(
         {"value": 500.0, "mode": "committee"},

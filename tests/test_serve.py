@@ -42,11 +42,14 @@ def _bls_on():
 class CountingBackend:
     """Crypto-free batched backend: an item verifies True iff its
     signature ends with b"ok". Counts entry-point calls and items (the
-    same ledger ops/bls_backend.py CALL_COUNTS keeps for the real one)."""
+    same ledger ops/bls_backend.py CALL_COUNTS keeps for the real one).
+    Deliberately has NO batch_verify_rlc: the service must fall back to
+    the per-group path for such backends."""
 
     def __init__(self, delay_s=0.0, fail_always=False, fail_calls=()):
         self.calls = 0
         self.items = 0
+        self.rlc_calls = 0
         self.delay_s = delay_s
         self.fail_always = fail_always
         self.fail_calls = set(fail_calls)
@@ -95,6 +98,22 @@ class OracleBackend(CountingBackend):
         return np.array(
             [bls.AggregateVerify(pks, ms, s)
              for pks, ms, s in zip(pubkey_lists, message_lists, signatures)],
+            dtype=bool,
+        )
+
+    def batch_verify_rlc(self, items, mesh=None, rng=None):
+        """The micro-batch RLC entry the service routes whole flushes
+        through by default — resolved per item via the oracle so the
+        stream-equivalence gate exercises the routing with real crypto
+        on unique items only."""
+        self.calls += 1
+        self.rlc_calls += 1
+        self.items += len(items)
+        return np.array(
+            [bls.FastAggregateVerify(pks, msgs, sig)
+             if kind == "fast_aggregate"
+             else bls.AggregateVerify(pks, msgs, sig)
+             for kind, pks, msgs, sig in items],
             dtype=bool,
         )
 
@@ -344,6 +363,9 @@ def test_randomized_stream_equivalence_vs_oracle():
     # every duplicate verified exactly once: the backend saw each distinct
     # item one time, and dedup absorbed everything else
     assert be.items == len(pool)
+    # micro-batches rode the default RLC route (whole-flush combine), not
+    # the per-(kind, K-bucket) path
+    assert be.rlc_calls > 0
     m = svc.metrics
     assert m.cache_hits + m.inflight_joins == len(events) - len(pool)
     assert m.hit_rate > 0
@@ -354,11 +376,13 @@ def test_randomized_stream_equivalence_vs_oracle():
     assert 0 < snap["occupancy_rows"] <= 1
 
 
-def test_service_with_real_device_backend():
+def test_service_with_real_device_backend(monkeypatch):
     """The service in front of the REAL batched backend, at the exact
-    shapes tests/test_bls_backend_fast.py compiles on every default run
-    (bucket 2, two rows) — ties the serve plane to the device path in
-    tier-1 without new compile cost."""
+    shapes tests/test_bls_backend_fast.py and tests/test_rlc.py compile
+    on every default run — both submits flush as ONE micro-batch through
+    batch_verify_rlc (the serve default), whose failed combined check
+    bisects down to exact per-item verdicts."""
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_RLC_CHUNK", "2")
     sk1, sk2 = 41, 42
     pk1, pk2 = bls.SkToPk(sk1), bls.SkToPk(sk2)
     msg = b"\x05" * 32
@@ -370,8 +394,8 @@ def test_service_with_real_device_backend():
     svc = VerificationService(max_batch=2, max_wait_ms=10_000)
     try:
         f_good = svc.submit("fast_aggregate", [pk1, pk2], msg, agg)
-        # same K bucket (2) so both ride ONE grouped backend call; the
-        # doubled pk1 aggregates to the wrong key -> False
+        # same K bucket (2) so both ride ONE flush; the doubled pk1
+        # aggregates to the wrong key -> False
         f_bad = svc.submit("fast_aggregate", [pk1, pk1], msg, agg)
         assert f_good.result(timeout=300) is True
         assert f_bad.result(timeout=300) is False
@@ -379,9 +403,43 @@ def test_service_with_real_device_backend():
         assert svc.submit("fast_aggregate", [pk1, pk2], msg, agg).result() is True
     finally:
         svc.close(timeout=60)
-    assert bls_backend.CALL_COUNTS["batch_fast_aggregate_verify"] == 1
+    assert bls_backend.CALL_COUNTS["batch_verify_rlc"] == 1
+    assert bls_backend.CALL_COUNTS["batch_fast_aggregate_verify"] == 0
     assert bls_backend.CALL_COUNTS["items"] == 2
     assert svc.metrics.fallback_items == 0
+    snap = svc.metrics.snapshot()
+    assert snap["rlc"]["combines"] >= 1
+
+
+def test_rlc_env_off_reverts_to_per_group_path(monkeypatch):
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_RLC", "0")
+    be = OracleBackend()
+    kind, pks, msg, sig = _build_pool()[0]
+    with _svc(be, max_batch=1, max_wait_ms=0) as svc:
+        assert svc.submit(kind, pks, msg, sig).result(timeout=30) is True
+    assert be.rlc_calls == 0 and be.calls == 1  # grouped path answered
+
+
+def test_rlc_failure_degrades_to_per_group_then_oracle():
+    """An RLC-specific fault (batch_verify_rlc raising) must degrade to
+    the per-group batched path — NOT straight to the sequential oracle —
+    and still resolve every request correctly."""
+
+    class RlcBrokenBackend(CountingBackend):
+        def batch_verify_rlc(self, items, mesh=None, rng=None):
+            self.rlc_calls += 1
+            raise RuntimeError("combine program exploded")
+
+    be = RlcBrokenBackend()
+    with _svc(be, max_batch=2, max_wait_ms=10_000, backend_retries=1) as svc:
+        f1 = svc.submit("fast_aggregate", [PK], b"m1", b"a-ok")
+        f2 = svc.submit("fast_aggregate", [PK], b"m2", b"b-bad")
+        assert f1.result(timeout=10) is True
+        assert f2.result(timeout=10) is False
+    assert be.rlc_calls == 2  # attempt + bounded retry
+    assert be.items == 2  # the per-group path carried the batch
+    assert svc.metrics.fallback_items == 0  # oracle never needed
+    assert svc.metrics.backend_retries == 1
 
 
 # -- collector integration --------------------------------------------------
@@ -439,3 +497,7 @@ def test_pipeline_prep_device_split_in_snapshot():
         assert snap[key] >= 0.0
     assert "serial_fallback_items" in snap["prep"]
     assert "pool_broken" in snap["prep"]
+    # RLC amortization counters ride the snapshot too (deltas since this
+    # service was constructed; zero here — CountingBackend has no RLC)
+    assert snap["rlc"].get("combines", 0) == 0
+    assert snap["final_exps_per_item"] == 0.0

@@ -1,0 +1,108 @@
+"""Committed VECTORS_REPORT.md staleness gate + report determinism.
+
+Mirrors tests/test_render_spec.py's committed-document contract
+(ADVICE round 5): the in-repo sweep evidence must equal what
+tools/check_vectors.py would write for the actual vector tree, so the
+committed report can never silently diverge from the tree `make sweep`
+produced. The gate needs an emitted tree, so it skips where none exists
+(the report is meaningless without its subject); the format/determinism
+tests run everywhere on a synthetic tree.
+"""
+import importlib.util
+import os
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_CHECKER = os.path.join(_REPO, "tools", "check_vectors.py")
+
+
+def _load_checker():
+    spec = importlib.util.spec_from_file_location("check_vectors_under_test",
+                                                  _CHECKER)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _vector_tree():
+    """First existing candidate tree: env VECTORS_DIR, the Makefile
+    default, or the in-repo sweep target."""
+    candidates = []
+    if os.environ.get("VECTORS_DIR"):
+        candidates.append(os.environ["VECTORS_DIR"])
+    candidates.append(
+        os.path.join(_REPO, "..", "consensus-spec-tests", "tests")
+    )
+    candidates.append(os.path.join(_REPO, ".vectors"))
+    for c in candidates:
+        if os.path.isdir(c):
+            return c
+    return None
+
+
+def test_committed_report_matches_tree():
+    """The staleness gate: re-render the report from the tree and require
+    it byte-identical to the committed VECTORS_REPORT.md (run `make
+    sweep` after regenerating vectors)."""
+    root = _vector_tree()
+    if root is None:
+        pytest.skip("no emitted vector tree on this machine (make sweep)")
+    cv = _load_checker()
+    counts, incomplete, empty_cases, snappy_parts = cv.scan_tree(root)
+    # identical verdict derivation to the CLI, decode spot-check included
+    # (sample_decode_failures is deterministic for a given tree)
+    ok = (not incomplete and not empty_cases and sum(counts.values()) > 0
+          and not cv.sample_decode_failures(snappy_parts))
+    fresh = cv.render_report(counts, incomplete, empty_cases,
+                             snappy_parts, ok)
+    committed_path = os.path.join(_REPO, "VECTORS_REPORT.md")
+    assert os.path.exists(committed_path), "missing VECTORS_REPORT.md"
+    with open(committed_path) as f:
+        assert f.read() == fresh, (
+            "VECTORS_REPORT.md is stale — run `make sweep` after changing "
+            "the generators or the vector tree"
+        )
+
+
+def _fake_tree(tmp_path, n_cases=3):
+    for i in range(n_cases):
+        case = (tmp_path / "minimal" / "phase0" / "sanity" / "sanity"
+                / "pyspec_tests" / f"case_{i}")
+        case.mkdir(parents=True)
+        (case / "meta.yaml").write_text("description: x\n")
+    return tmp_path
+
+
+def test_report_is_deterministic_and_timestamp_free(tmp_path):
+    """Two renders of the same tree must be byte-identical — the report
+    may not embed timestamps, machine paths, or any other run-local state
+    (that is what makes the staleness gate above possible at all)."""
+    cv = _load_checker()
+    root = str(_fake_tree(tmp_path))
+    a = cv.render_report(*cv.scan_tree(root), ok=True)
+    b = cv.render_report(*cv.scan_tree(root), ok=True)
+    assert a == b
+    assert "| minimal | phase0 | sanity | 3 |" in a
+    assert "- total cases: **3**" in a
+    assert "- verdict: **PASS**" in a
+    assert str(tmp_path) not in a  # no machine-local paths
+    import re
+
+    assert not re.search(r"\b20\d\d-\d\d-\d\d\b", a)  # no date stamp
+
+
+def test_scan_tree_flags_incomplete_and_empty(tmp_path):
+    cv = _load_checker()
+    root = _fake_tree(tmp_path)
+    empty = (root / "minimal" / "phase0" / "sanity" / "sanity"
+             / "pyspec_tests" / "empty_case")
+    empty.mkdir(parents=True)
+    bad = (root / "minimal" / "phase0" / "sanity" / "sanity"
+           / "pyspec_tests" / "case_0" / "INCOMPLETE")
+    bad.write_text("")
+    counts, incomplete, empty_cases, _ = cv.scan_tree(str(root))
+    assert counts[("minimal", "phase0", "sanity")] == 4
+    assert len(incomplete) == 1 and len(empty_cases) == 1
+    report = cv.render_report(counts, incomplete, empty_cases, [], False)
+    assert "- verdict: **FAIL**" in report
