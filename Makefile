@@ -18,7 +18,7 @@ CHEAP_GENERATORS = shuffling bls ssz_generic merkle
 
 .PHONY: test citest test_tpu_backend lint generate_tests \
         detect_generator_incomplete check_vectors bench serve-bench codec-bench multichip \
-        clean_vectors generate_random_tests
+        clean_vectors generate_random_tests bench-compare check serve-trace
 
 # fast default: BLS stubbed except @always_bls, 4-way process-parallel
 # (reference `make test` = pytest -n 4, reference Makefile:100)
@@ -82,6 +82,17 @@ check_vectors:
 bench:
 	python bench.py
 
+# perf regression gate: diff the newest BENCH_r*.json headline against the
+# previous round's, keyed by (platform, mode, NxK shape) so CPU fallbacks
+# never score against TPU windows; exits nonzero past the allowed drop
+# (BENCH_COMPARE_MAX_REGRESSION percent, default 30) — part of `make check`
+# so a perf regression is a visible failure, not a silently worse artifact
+bench-compare:
+	python tools/bench_compare.py
+
+# the static + perf check flow CI runs alongside the test matrix
+check: lint bench-compare
+
 # streaming serve plane (consensus_specs_tpu/serve/): short CPU-sized
 # synthetic gossip load — Poisson arrivals, duplicate-heavy traffic, one
 # injected backend failure — through the continuous-batching
@@ -90,6 +101,13 @@ bench:
 # and the prep-vs-device time split of the two-stage pipeline
 serve-bench:
 	JAX_PLATFORMS=cpu python bench.py --mode serve
+
+# serve bench with the full observability plane on: per-request span
+# tracing exported as Chrome trace-event JSON (open serve_trace.json in
+# chrome://tracing or Perfetto) and the /metrics + /snapshot + /healthz
+# endpoint live on an ephemeral port during the run
+serve-trace:
+	JAX_PLATFORMS=cpu SERVE_METRICS_PORT=0 python bench.py --mode serve --trace serve_trace.json
 
 # prep-only microbenchmark: the batched input codec (ops/codec.py —
 # decompression, subgroup checks, hash-to-G2) vs the per-item pure-Python

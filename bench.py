@@ -45,7 +45,11 @@ failure) through the streaming VerificationService
 (consensus_specs_tpu/serve/) in-process on CPU, and its JSON line carries
 sustained signatures/sec plus the serving numbers — batch occupancy, cache
 hit rate, p50/p95/p99 submit->result latency, and the prep-vs-device time
-split per flush (knobs: SERVE_* env vars, see serve/load.py).
+split per flush (knobs: SERVE_* env vars, see serve/load.py). Add
+`--trace out.json` to record per-request spans (queue-wait/prep/device/
+combine/finalize) + VM program executions and export Chrome trace-event
+JSON; SERVE_METRICS_PORT=<port|0> additionally serves Prometheus
+`/metrics` + `/snapshot` + `/healthz` during the run (obs/).
 
 `--mode codec` is the prep-only microbenchmark: the batched input codec
 (ops/codec.py) vs the per-item pure-Python prep path, items/sec over
@@ -128,6 +132,17 @@ def run_workload(emit_partial=None, override=None, child_quick=False) -> dict:
     deadline on the ~20-min comparable shape. Env overrides still win."""
     import jax
 
+    from consensus_specs_tpu.obs import programs as obs_programs
+    from consensus_specs_tpu.ops import profiling
+
+    # each workload/stage starts from clean accumulators: the child runs
+    # committee THEN epoch in one process, and without a reset the first
+    # mode's latencies/gauges would bleed into the next mode's attached
+    # profile summary. The vm-cache gauges are re-published afterwards —
+    # their note_assembly source fires only once per program per process
+    profiling.reset()
+    obs_programs.export_gauges()
+
     platform = jax.default_backend()
     if child_quick and platform == "cpu" and not _bench_env_overridden():
         override = _WARMUP_OVERRIDE
@@ -198,9 +213,7 @@ def run_workload(emit_partial=None, override=None, child_quick=False) -> dict:
     best = times[len(times) // 2] if times else warm
 
     final = result(n * k / best)
-    if os.environ.get("CONSENSUS_SPECS_TPU_PROFILE") == "1":
-        from consensus_specs_tpu.ops import profiling
-
+    if profiling.enabled():  # dynamic check: env flips after import count
         final["profile"] = profiling.summary()
     return final
 
@@ -355,15 +368,20 @@ def _run_child_attempt(timeout: float):
     return None, f"accelerator attempt rc={rc}: {' | '.join(err_tail)}"
 
 
-def _cli_mode():
-    """`--mode <m>` / `--mode=<m>` from argv (bench.py's only CLI flag)."""
+def _cli_opt(name):
+    """`<name> <v>` / `<name>=<v>` from argv."""
     argv = sys.argv[1:]
     for i, arg in enumerate(argv):
-        if arg == "--mode" and i + 1 < len(argv):
+        if arg == name and i + 1 < len(argv):
             return argv[i + 1]
-        if arg.startswith("--mode="):
+        if arg.startswith(name + "="):
             return arg.split("=", 1)[1]
     return None
+
+
+def _cli_mode():
+    """`--mode <m>` / `--mode=<m>` from argv."""
+    return _cli_opt("--mode")
 
 
 def main():
@@ -374,12 +392,26 @@ def main():
         # line's value is the service-layer numbers (occupancy, cache hit
         # rate, latency percentiles) on a CPU-sized load — SERVE_* env
         # vars scale it up inside a granted window
+        # `--trace out.json` turns on the span tracer for the whole run
+        # and exports Chrome trace-event JSON (pipeline spans + VM program
+        # executions + per-program registry) after the load completes
+        trace_path = _cli_opt("--trace")
+        if trace_path:
+            os.environ["CONSENSUS_SPECS_TPU_TRACE"] = "1"
         from consensus_specs_tpu.utils.jax_env import force_cpu
 
         force_cpu()
         from consensus_specs_tpu.serve.load import run_serve_bench
 
-        _emit_result(run_serve_bench())
+        result = run_serve_bench()
+        if trace_path:
+            from consensus_specs_tpu.obs import tracing
+
+            result["trace"] = tracing.dump_trace(trace_path)
+            # monotone count (NOT the ring length): a scaled run traces
+            # more requests than the ring retains spans for
+            result["trace_requests"] = tracing.global_tracer().finished_total()
+        _emit_result(result)
         return
 
     if _cli_mode() == "codec":

@@ -1,0 +1,31 @@
+"""Observability plane: request tracing, metric registry, exposition.
+
+The serve pipeline and the VM execution engine record into this package;
+it exports three surfaces:
+
+- ``tracing``   — per-request spans (queue_wait/prep/device/combine/
+                  finalize) in a bounded ring with slow-request exemplar
+                  pinning, plus VM execution events; Chrome trace-event
+                  export (``dump_trace`` / ``bench.py --mode serve
+                  --trace``). Opt-in via ``CONSENSUS_SPECS_TPU_TRACE=1``.
+- ``registry``  — the canonical metric-name registry (drift-gated by
+                  tier-1) and the Prometheus text renderer.
+- ``exposition``— opt-in stdlib HTTP endpoint: ``/metrics`` (Prometheus),
+                  ``/snapshot`` (ServeMetrics JSON), ``/healthz``.
+- ``programs``  — per-VM-program registry (steps, register-file size,
+                  assembly time, ``.vm_cache/`` hit/miss).
+
+Import cost is stdlib-only; nothing here imports jax, and ``ops`` modules
+are only reached lazily at render/record time (so ops <-> obs never
+cycles).
+"""
+from .exposition import ExpositionServer, start_exposition  # noqa: F401
+from .tracing import (  # noqa: F401
+    STAGES,
+    Tracer,
+    dump_trace,
+    global_tracer,
+    maybe_tracer,
+    reset_global,
+    trace_enabled,
+)

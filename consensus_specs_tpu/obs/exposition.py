@@ -1,0 +1,109 @@
+"""Opt-in metrics exposition endpoint (stdlib ``http.server``, daemon
+threads, no third-party deps — the container has no prometheus_client).
+
+Routes:
+  ``/metrics``  Prometheus text format 0.0.4 (``obs/registry.py`` renders
+                the live ``profiling.summary()`` snapshot);
+  ``/snapshot`` the wired ``ServeMetrics.snapshot()`` JSON (or the
+                profiling summary when no service is attached);
+  ``/healthz``  liveness.
+
+Explicitly opt-in: nothing in the serve plane binds a port unless
+``start_exposition`` is called (the serve bench does it when
+``SERVE_METRICS_PORT`` is set). ``port=0`` binds an ephemeral port; read
+it back from ``server.port``. Scrapes read shared accumulators under the
+same locks the writers use — a scrape can delay a writer by microseconds
+but never corrupt it, and a handler exception answers 500, never kills
+the daemon thread.
+"""
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from . import registry
+
+
+def _default_snapshot():
+    from ..ops import profiling
+
+    return {"profile": profiling.summary()}
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "consensus-specs-tpu-obs/1"
+
+    def do_GET(self):  # noqa: N802 (http.server API)
+        path = self.path.split("?", 1)[0]
+        try:
+            if path == "/metrics":
+                body = registry.render_prometheus().encode()
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+            elif path == "/snapshot":
+                body = json.dumps(self.server.snapshot_fn(),
+                                  sort_keys=True).encode()
+                ctype = "application/json"
+            elif path == "/healthz":
+                body = b'{"ok": true}'
+                ctype = "application/json"
+            else:
+                self.send_error(404, "unknown path")
+                return
+        except Exception as e:  # a broken scrape must answer, not die
+            try:
+                self.send_error(500, f"{type(e).__name__}: {e}"[:200])
+            except Exception:
+                pass
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):  # no stderr line per scrape
+        pass
+
+
+class ExpositionServer:
+    """A bound-and-serving exposition endpoint on a daemon thread."""
+
+    def __init__(self, snapshot_fn=None, host: str = "127.0.0.1",
+                 port: int = 0):
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.snapshot_fn = snapshot_fn or _default_snapshot
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="obs-exposition",
+            daemon=True,
+        )
+        self._thread.start()
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def url(self, path: str = "/metrics") -> str:
+        host = self._httpd.server_address[0]
+        return f"http://{host}:{self.port}{path}"
+
+    def close(self, timeout: float = 5.0) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def start_exposition(metrics=None, snapshot_fn=None, host: str = "127.0.0.1",
+                     port: int = 0) -> ExpositionServer:
+    """Start the endpoint. ``metrics`` is a ``ServeMetrics`` (its
+    ``snapshot`` becomes ``/snapshot``); ``snapshot_fn`` overrides; with
+    neither, ``/snapshot`` serves the profiling summary."""
+    if snapshot_fn is None and metrics is not None:
+        snapshot_fn = metrics.snapshot
+    return ExpositionServer(snapshot_fn=snapshot_fn, host=host, port=port)

@@ -1,0 +1,196 @@
+"""Canonical metric-name registry + Prometheus text rendering.
+
+Single source of truth for every label the codebase feeds into
+``ops/profiling`` (point-in-time gauges via ``set_gauge``, stat
+accumulators via ``record``/``timed``, latency reservoirs via
+``record_latency``). The tier-1 drift gate
+(``tests/test_metrics_registry.py``) scans the package sources for emitted
+label strings and fails when one is missing here, and fails again when a
+name registered here is missing from the README metric table — so a rename
+can never silently orphan a dashboard or a scrape rule.
+
+``render_prometheus()`` is the pull side of the exposition plane
+(``obs/exposition.py`` serves it at ``/metrics``): it reads
+``profiling.summary()`` — the same snapshot every bench JSON line attaches
+— and renders Prometheus text format 0.0.4. Registered names become
+first-class metric families; dynamic labels (the per-shape VM execution
+timings, ``vm[steps=...,regs=...,batch=...]``) map onto ONE family with the
+full label string as a ``label`` label, so high-cardinality shapes never
+mint unbounded metric names.
+"""
+import re
+from typing import Dict, Iterable
+
+PROM_PREFIX = "consensus_specs_tpu_"
+
+# -- the registry -----------------------------------------------------------
+
+GAUGES: Dict[str, str] = {
+    "serve.queue_depth": "ingress queue depth after the last enqueue/flush",
+    "serve.cache_hit_rate": "share of non-eager submits answered by the "
+                            "result cache or in-flight dedup",
+    "serve.occupancy_rows": "filled batch rows / padded rows (batch axis "
+                            "rounds up to a power of two)",
+    "serve.occupancy_lanes": "actual committee keys / (rows * K bucket)",
+    "bls.prep_pool_broken": "1 when the prewarm process pool has latched "
+                            "broken (reset_prep_state() clears)",
+    "bls.prep_serial_fallback_items": "items that degraded to serial "
+                                      "per-item host prep",
+    "bls.rlc_combines": "RLC combine programs run (process-wide)",
+    "bls.rlc_bisections": "failed combined checks that forced a bisection "
+                          "split",
+    "bls.final_exps": "final exponentiations paid (device rows incl. "
+                      "padding + host-oracle hard parts)",
+    "bls.vm_cache_hits": "assembled VM programs served from the .vm_cache/ "
+                         "disk cache this process",
+    "bls.vm_cache_misses": "VM programs that had to pay host assembly "
+                           "(list scheduling) this process",
+}
+
+STATS: Dict[str, str] = {
+    "serve.batch_flush": "per-(kind, K-bucket) group verification time "
+                         "within a flush",
+    "serve.prep_flush": "host codec prep time per micro-batch (pipeline "
+                        "stage 1)",
+    "serve.prep_error": "prep-stage exceptions (prep is an optimization; "
+                        "the device stage re-derives)",
+    "serve.rlc_error": "whole-flush RLC attempts that exhausted retries "
+                       "and fell back to the per-group path",
+    "serve.backend_error": "per-group backend failures that degraded to "
+                           "the sequential oracle",
+    "bls.codec_prewarm_error": "batched-codec prewarm failures (per-item "
+                               "prep path took over)",
+}
+
+LATENCIES: Dict[str, str] = {
+    "serve.submit_to_result": "submit()->Future-resolution latency "
+                              "(p50/p95/p99 over a bounded reservoir)",
+}
+
+# dynamic label families: labels built at runtime with a shape/program
+# payload; ``prefix`` -> (prometheus family, help). The whole label string
+# is exposed as a `label` label on the family.
+DYNAMIC_PREFIXES: Dict[str, tuple] = {
+    "vm[": ("vm_execute", "per-program VM execution timing, labelled "
+                          "vm[steps=...,regs=...,batch=...,sharded=...]"),
+}
+
+
+def all_names() -> Iterable[str]:
+    """Every registered static metric name (drift-gate + docs surface)."""
+    names = []
+    names.extend(sorted(GAUGES))
+    names.extend(sorted(STATS))
+    names.extend(sorted(LATENCIES))
+    return names
+
+
+def known(label: str) -> bool:
+    """True when ``label`` is registered (exactly or via a dynamic prefix)."""
+    if label in GAUGES or label in STATS or label in LATENCIES:
+        return True
+    return any(label.startswith(p) for p in DYNAMIC_PREFIXES)
+
+
+# -- Prometheus text rendering ----------------------------------------------
+
+
+def _ident(label: str) -> str:
+    return re.sub(r"[^a-zA-Z0-9_]", "_", label)
+
+
+def _escape(value: str) -> str:
+    return (value.replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _family(label: str):
+    """(prometheus base name, label-value or None) for a profiling label."""
+    if label in GAUGES or label in STATS or label in LATENCIES:
+        return PROM_PREFIX + _ident(label), None
+    for prefix, (fam, _help) in DYNAMIC_PREFIXES.items():
+        if label.startswith(prefix):
+            return PROM_PREFIX + fam, label
+    return PROM_PREFIX + "unregistered", label
+
+
+def _series(name: str, label_value, value) -> str:
+    if label_value is None:
+        return f"{name} {value}"
+    return f'{name}{{label="{_escape(label_value)}"}} {value}'
+
+
+def render_prometheus() -> str:
+    """Prometheus text format 0.0.4 over the live profiling snapshot.
+
+    Stat accumulators render as ``_calls_total``/``_seconds_total``
+    counters + a ``_max_seconds`` gauge; latency reservoirs render as a
+    summary (quantiles 0.5/0.95/0.99 + ``_sum``/``_count``) + a
+    ``_max_seconds`` gauge; gauges render as-is. HELP/TYPE headers are
+    emitted once per family even when dynamic labels fan it out into many
+    series.
+    """
+    from ..ops import profiling
+
+    snap = profiling.summary()
+    # family -> {"type": ..., "help": ..., "lines": [...]}
+    families: Dict[str, Dict] = {}
+
+    def fam(name, mtype, help_text):
+        f = families.get(name)
+        if f is None:
+            f = families[name] = {"type": mtype, "help": help_text,
+                                  "lines": []}
+        return f["lines"]
+
+    for label, entry in sorted(snap.items()):
+        base, label_value = _family(label)
+        if "gauge" in entry:
+            help_text = GAUGES.get(label, "unregistered gauge")
+            fam(base, "gauge", help_text).append(
+                _series(base, label_value, entry["gauge"]))
+        elif "p50_ms" in entry:
+            help_text = LATENCIES.get(label, "latency reservoir")
+            name = base + "_latency_seconds"
+            lines = fam(name, "summary", help_text)
+            for q, key in (("0.5", "p50_ms"), ("0.95", "p95_ms"),
+                           ("0.99", "p99_ms")):
+                if label_value is None:
+                    lines.append(f'{name}{{quantile="{q}"}} '
+                                 f"{entry[key] / 1e3}")
+                else:
+                    lines.append(
+                        f'{name}{{label="{_escape(label_value)}",'
+                        f'quantile="{q}"}} {entry[key] / 1e3}')
+            count = entry["count"]
+            lines.append(_series(
+                name + "_sum", label_value,
+                round(entry["mean_ms"] / 1e3 * count, 6)))
+            lines.append(_series(name + "_count", label_value, count))
+            max_name = base + "_latency_max_seconds"
+            fam(max_name, "gauge", help_text + " (max)").append(
+                _series(max_name, label_value, entry["max_ms"] / 1e3))
+        else:  # stat accumulator: calls/total_s/max_s
+            help_text = STATS.get(label)
+            if help_text is None and label_value is not None:
+                for prefix, (f_name, f_help) in DYNAMIC_PREFIXES.items():
+                    if label.startswith(prefix):
+                        help_text = f_help
+                        break
+            help_text = help_text or "unregistered stat"
+            fam(base + "_calls_total", "counter", help_text).append(
+                _series(base + "_calls_total", label_value, entry["calls"]))
+            fam(base + "_seconds_total", "counter",
+                help_text + " (seconds)").append(
+                _series(base + "_seconds_total", label_value,
+                        entry["total_s"]))
+            fam(base + "_max_seconds", "gauge", help_text + " (max)").append(
+                _series(base + "_max_seconds", label_value, entry["max_s"]))
+
+    out = []
+    for name in sorted(families):
+        f = families[name]
+        out.append(f"# HELP {name} {f['help']}")
+        out.append(f"# TYPE {name} {f['type']}")
+        out.extend(f["lines"])
+    return "\n".join(out) + "\n"

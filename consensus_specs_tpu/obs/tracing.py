@@ -1,0 +1,309 @@
+"""Span-based request tracing for the serve pipeline + VM execution plane.
+
+Every accepted ``VerificationService.submit()`` gets a ``RequestTrace``
+that the pipeline stages stamp with spans — ``queue_wait`` (submit ->
+pulled by the prep stage), ``prep`` (host codec), ``device`` (the flush's
+hard part), ``combine`` (the RLC combined check / bisection inside it) and
+``finalize`` (cache write + future resolution). Completed traces live in a
+bounded ring buffer; anything slower than the running p99 is pinned into a
+separate exemplar ring so the slow tail survives ring churn ("why was THIS
+request slow" is answerable after the fact, not only while watching).
+
+Tracing is OPT-IN and zero-cost when off: the service holds ``None``
+instead of a tracer (no new locks or branches beyond one ``is not None``
+per stage), and ``vm.execute`` checks :func:`trace_enabled` — a plain env
+read — before recording anything. Enable with ``CONSENSUS_SPECS_TPU_TRACE=1``
+(picked up dynamically, same contract as ``profiling.enabled()``) or pass
+an explicit ``Tracer`` to the service.
+
+Export is Chrome trace-event JSON (chrome://tracing or Perfetto's "Open
+trace file"): pipeline spans on pid 1 (one row per request), VM program
+executions on pid 2, plus the per-program registry (``obs/programs.py``:
+steps, register-file size, assembly time, ``.vm_cache/`` hit/miss) under
+the top-level ``programRegistry`` key. ``bench.py --mode serve --trace
+out.json`` wires the whole thing end to end.
+"""
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+TRACE_ENV = "CONSENSUS_SPECS_TPU_TRACE"
+
+# the five pipeline stages every traced request can carry (the acceptance
+# surface of the serve trace; `combine` only appears on RLC-routed flushes)
+STAGES = ("queue_wait", "prep", "device", "combine", "finalize")
+
+
+def trace_enabled() -> bool:
+    """Dynamic env check — flipping the env after import takes effect on
+    the next service construction / VM execution."""
+    return os.environ.get(TRACE_ENV, "0") not in ("", "0")
+
+
+class RequestTrace:
+    """One request's journey through the pipeline.
+
+    Spans append WITHOUT a lock: every stage is a single writer (submit
+    thread -> prep thread -> device thread, strictly sequenced by the
+    service's queues), so only the tracer's shared rings need locking.
+    """
+
+    __slots__ = ("rid", "kind", "n_keys", "t_submit", "spans", "total_s",
+                 "ok", "pinned")
+
+    def __init__(self, rid: int, kind: str, n_keys: int, t_submit: float):
+        self.rid = rid
+        self.kind = kind
+        self.n_keys = n_keys
+        self.t_submit = t_submit
+        self.spans: List[Tuple[str, float, float]] = []
+        self.total_s: Optional[float] = None
+        self.ok: Optional[bool] = None
+        self.pinned = False
+
+    def span_names(self):
+        return {name for name, _, _ in self.spans}
+
+    def to_dict(self) -> Dict:
+        return {
+            "rid": self.rid,
+            "kind": self.kind,
+            "n_keys": self.n_keys,
+            "ok": self.ok,
+            "pinned": self.pinned,
+            "total_ms": (round(self.total_s * 1e3, 3)
+                         if self.total_s is not None else None),
+            "spans": {name: round((b - a) * 1e3, 3)
+                      for name, a, b in self.spans},
+        }
+
+
+class Tracer:
+    """Bounded-memory span collector with slow-request exemplar capture.
+
+    ``capacity`` bounds the completed-trace ring AND the VM-execution ring;
+    ``exemplar_capacity`` bounds the pinned slow tail. ``clock`` is
+    injectable so the Chrome-export golden test is deterministic.
+    """
+
+    # refresh the running-p99 estimate every this many finishes (sorting
+    # the window per finish would tax the enabled hot path needlessly)
+    _P99_REFRESH = 32
+
+    def __init__(self, capacity: int = 512, exemplar_capacity: int = 32,
+                 clock=time.perf_counter):
+        assert capacity > 0 and exemplar_capacity > 0
+        self.clock = clock
+        self._t0 = clock()  # trace epoch: chrome ts are offsets from here
+        self._lock = threading.Lock()
+        self._ids = itertools.count(1)
+        self._ring: "deque[RequestTrace]" = deque(maxlen=capacity)
+        self._exemplars: "deque[RequestTrace]" = deque(
+            maxlen=exemplar_capacity)
+        self._totals: "deque[float]" = deque(maxlen=1024)  # p99 window
+        self._p99 = 0.0
+        self._finished = 0
+        self._executions: "deque[Dict]" = deque(maxlen=capacity)
+
+    # -- recording (service / vm hooks) -------------------------------------
+
+    def begin(self, kind: str, n_keys: int,
+              t_submit: Optional[float] = None) -> RequestTrace:
+        if t_submit is None:
+            t_submit = self.clock()
+        return RequestTrace(next(self._ids), kind, n_keys, t_submit)
+
+    def span(self, trace: RequestTrace, name: str, t0: float,
+             t1: float) -> None:
+        trace.spans.append((name, t0, t1))
+
+    def span_many(self, traces, name: str, t0: float, t1: float) -> None:
+        """Stamp one shared stage interval onto a whole micro-batch
+        (batch stages cost the same wall time for every member)."""
+        for tr in traces:
+            if tr is not None:
+                tr.spans.append((name, t0, t1))
+
+    def finish(self, trace: RequestTrace, ok: bool,
+               t_done: Optional[float] = None) -> None:
+        if t_done is None:
+            t_done = self.clock()
+        trace.ok = bool(ok)
+        trace.total_s = t_done - trace.t_submit
+        with self._lock:
+            # a trace begun before this tracer existed (explicit t_submit)
+            # must not export negative timestamps — rewind the epoch
+            if trace.t_submit < self._t0:
+                self._t0 = trace.t_submit
+            self._finished += 1
+            # pin BEFORE folding this total into the window: "over the
+            # RUNNING p99" means the p99 of everything before this request
+            pin = bool(self._totals) and trace.total_s >= self._p99
+            self._totals.append(trace.total_s)
+            if self._p99 == 0.0 or self._finished % self._P99_REFRESH == 1:
+                ordered = sorted(self._totals)
+                self._p99 = ordered[min(len(ordered) - 1,
+                                        (99 * len(ordered)) // 100)]
+            if pin:
+                trace.pinned = True
+                self._exemplars.append(trace)
+            self._ring.append(trace)
+
+    def note_execution(self, *, steps: int, regs: int, batch, sharded: bool,
+                       t0: float, seconds: float) -> None:
+        """One VM program execution (vm.execute hook)."""
+        with self._lock:
+            # the FIRST traced execution may predate the lazily-created
+            # global tracer (t0 is captured before the device call, and
+            # that call can be a tens-of-seconds compile): rewind the
+            # epoch so Perfetto never clamps/drops the most expensive
+            # event for sitting before the trace origin
+            if t0 < self._t0:
+                self._t0 = t0
+            self._executions.append({
+                "steps": int(steps),
+                "regs": int(regs),
+                "batch": list(batch),
+                "sharded": bool(sharded),
+                "t0": t0,
+                "seconds": seconds,
+            })
+
+    # -- reading ------------------------------------------------------------
+
+    def completed(self) -> List[RequestTrace]:
+        with self._lock:
+            return list(self._ring)
+
+    def exemplars(self) -> List[RequestTrace]:
+        with self._lock:
+            return list(self._exemplars)
+
+    def executions(self) -> List[Dict]:
+        with self._lock:
+            return [dict(e) for e in self._executions]
+
+    def running_p99_s(self) -> float:
+        with self._lock:
+            return self._p99
+
+    def finished_total(self) -> int:
+        """Monotone count of finished traces — unlike ``completed()``,
+        not capped by the ring, so scaled runs can report how many
+        requests were traced vs how many the ring still holds."""
+        with self._lock:
+            return self._finished
+
+    # -- chrome trace-event export -------------------------------------------
+
+    def _us(self, t: float) -> float:
+        return round((t - self._t0) * 1e6, 3)
+
+    def to_chrome(self) -> Dict:
+        """Chrome trace-event JSON object (load in chrome://tracing or
+        Perfetto). Pipeline spans are complete ("X") events on pid 1, one
+        tid per request; VM executions are "X" events on pid 2; the
+        per-program registry rides the (spec-sanctioned) extra top-level
+        key ``programRegistry``."""
+        from . import programs
+
+        with self._lock:
+            traces = list(self._ring)
+            execs = list(self._executions)
+            exemplars = list(self._exemplars)
+            p99_s = self._p99
+            finished = self._finished
+        events: List[Dict] = [
+            {"ph": "M", "name": "process_name", "pid": 1,
+             "args": {"name": "serve-pipeline"}},
+            {"ph": "M", "name": "process_name", "pid": 2,
+             "args": {"name": "vm-programs"}},
+        ]
+        for tr in traces:
+            events.append({
+                "ph": "M", "name": "thread_name", "pid": 1, "tid": tr.rid,
+                "args": {"name": f"req-{tr.rid} {tr.kind} k={tr.n_keys}"},
+            })
+            for name, a, b in tr.spans:
+                args = {"kind": tr.kind, "n_keys": tr.n_keys}
+                if name == "finalize":
+                    args.update(ok=tr.ok, pinned=tr.pinned,
+                                total_ms=round((tr.total_s or 0.0) * 1e3, 3))
+                events.append({
+                    "name": name, "cat": "serve", "ph": "X",
+                    "pid": 1, "tid": tr.rid,
+                    "ts": self._us(a),
+                    "dur": round(max(0.0, b - a) * 1e6, 3),
+                    "args": args,
+                })
+        for ex in execs:
+            events.append({
+                "name": (f"vm[steps={ex['steps']},regs={ex['regs']},"
+                         f"batch={tuple(ex['batch'])}]"),
+                "cat": "vm", "ph": "X", "pid": 2, "tid": 1,
+                "ts": self._us(ex["t0"]),
+                "dur": round(max(0.0, ex["seconds"]) * 1e6, 3),
+                "args": {"steps": ex["steps"], "regs": ex["regs"],
+                         "batch": ex["batch"], "sharded": ex["sharded"]},
+            })
+        return {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "programRegistry": programs.registry_snapshot(),
+            "otherData": {
+                # requests = spans present in this export (ring-bounded);
+                # finished_total = every trace ever finished — when they
+                # differ, the ring dropped the oldest (finished_total -
+                # requests) requests' spans
+                "requests": len(traces),
+                "finished_total": finished,
+                "exemplars": [t.to_dict() for t in exemplars],
+                "running_p99_ms": round(p99_s * 1e3, 3),
+            },
+        }
+
+    def dump(self, path: str) -> str:
+        doc = self.to_chrome()
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as fh:
+            json.dump(doc, fh, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+        return path
+
+
+# -- process-global tracer ---------------------------------------------------
+
+_global_lock = threading.Lock()
+_global: Optional[Tracer] = None
+
+
+def global_tracer() -> Tracer:
+    """The process tracer (created on first use); what ``vm.execute`` and
+    env-enabled services record into, and what ``dump_trace`` exports."""
+    global _global
+    with _global_lock:
+        if _global is None:
+            _global = Tracer()
+        return _global
+
+
+def maybe_tracer() -> Optional[Tracer]:
+    """The global tracer when tracing is enabled, else None — the exact
+    value the service stores, so the disabled path is a None check."""
+    return global_tracer() if trace_enabled() else None
+
+
+def reset_global() -> None:
+    """Drop the global tracer (tests / multi-run benches)."""
+    global _global
+    with _global_lock:
+        _global = None
+
+
+def dump_trace(path: str) -> str:
+    """Export the global tracer's rings as Chrome trace-event JSON."""
+    return global_tracer().dump(path)

@@ -29,6 +29,7 @@ the device, matching the oracle's exception-swallowing wrappers
 """
 import functools
 import os
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -171,9 +172,12 @@ def _program(kind: str, k: int = 0, fold: int = None) -> Tuple[vm.Program, int]:
         f"v{_VM_CACHE_VERSION}_{_builder_fingerprint()}_{kind}_k{k}_f{fold}"
         f"_w{W_MUL}x{W_LIN}_p{PAD_STEPS}.pkl",
     )
+    t0 = time.perf_counter()
     try:
         with open(path, "rb") as fh:
-            return pickle.load(fh), fold
+            loaded = pickle.load(fh)
+        _note_program(kind, k, fold, loaded, time.perf_counter() - t0, True)
+        return loaded, fold
     except Exception:
         pass  # absent/stale cache: assemble below
     if kind == "miller_product":
@@ -198,6 +202,7 @@ def _program(kind: str, k: int = 0, fold: int = None) -> Tuple[vm.Program, int]:
         pad_steps_to=PAD_STEPS,
         pad_regs_to=_pow2(64),
     )
+    _note_program(kind, k, fold, assembled, time.perf_counter() - t0, False)
     try:
         tmp = f"{path}.{os.getpid()}.tmp"
         with open(tmp, "wb") as fh:
@@ -206,6 +211,24 @@ def _program(kind: str, k: int = 0, fold: int = None) -> Tuple[vm.Program, int]:
     except Exception:
         pass  # cache write is an optimization only
     return assembled, fold
+
+
+def _note_program(kind: str, k: int, fold: int, assembled, seconds: float,
+                  disk_hit: bool) -> None:
+    """Feed the per-program observability registry (obs/programs.py):
+    steps, register-file size, assembly-or-load time, .vm_cache/ hit/miss.
+    Called once per (kind, k, fold) per process (the lru_cache on
+    _program absorbs repeats); never allowed to break program resolution."""
+    try:
+        from ..obs import programs as obs_programs
+
+        obs_programs.note_assembly(
+            f"{kind}[k={k},fold={fold}]",
+            n_steps=assembled.n_steps, n_regs=assembled.n_regs,
+            seconds=seconds, disk_cache_hit=disk_hit,
+        )
+    except Exception:
+        pass
 
 
 # ---------------------------------------------------------------------------

@@ -25,7 +25,23 @@ import time
 from collections import defaultdict
 from typing import Dict, List
 
-ENABLED = os.environ.get("CONSENSUS_SPECS_TPU_PROFILE") == "1"
+def enabled() -> bool:
+    """Whether CONSENSUS_SPECS_TPU_PROFILE=1 — re-read on EVERY call, so
+    enabling profiling after import (from a test, the serve endpoint, a
+    REPL) takes effect immediately. The historical module-level ``ENABLED``
+    read stays correct through the dynamic alias below."""
+    return os.environ.get("CONSENSUS_SPECS_TPU_PROFILE") == "1"
+
+
+_RESERVOIR_SEED = 0x5EED
+
+
+def __getattr__(name: str):
+    # PEP 562: keep `profiling.ENABLED` working as a DYNAMIC read — a
+    # frozen import-time bool silently ignored env flips made after import
+    if name == "ENABLED":
+        return enabled()
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 _stats: Dict[str, Dict[str, float]] = defaultdict(
     lambda: {"calls": 0, "total_s": 0.0, "max_s": 0.0}
@@ -36,7 +52,7 @@ RESERVOIR_CAP = 4096
 _lat: Dict[str, Dict] = defaultdict(
     lambda: {"count": 0, "total_s": 0.0, "max_s": 0.0, "sample": []}
 )
-_lat_rng = random.Random(0x5EED)  # deterministic: reruns sample identically
+_lat_rng = random.Random(_RESERVOIR_SEED)  # deterministic: reruns sample identically
 # one lock for every accumulator: the serve plane writes timings, gauges
 # AND latencies concurrently from submit threads and its worker, so an
 # unlocked summary() could see a dict resize mid-iteration
@@ -131,10 +147,16 @@ def summary() -> Dict[str, Dict[str, float]]:
 
 
 def reset() -> None:
+    """Clear ALL THREE accumulator families — per-label stats, latency
+    reservoirs, gauges — and re-seed the reservoir RNG, so a post-reset
+    run is indistinguishable from a fresh process (multi-mode bench runs
+    reset between modes; determinism is part of the reruns-are-comparable
+    contract)."""
     with _lock:
         _stats.clear()
         _lat.clear()
         _gauges.clear()
+        _lat_rng.seed(_RESERVOIR_SEED)
 
 
 def report() -> str:

@@ -24,6 +24,7 @@ assembler tracks magnitude bounds per value and auto-inserts compress
 multiplies, so lazy reduction is handled statically at assembly time.
 """
 import os
+import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
@@ -574,10 +575,24 @@ def execute(program: Program, inputs: Dict[str, np.ndarray], batch_shape=(),
         f"vm[steps={program.n_steps},regs={program.n_regs},"
         f"batch={tuple(batch_shape)},sharded={mesh is not None}]"
     )
+    t0 = time.perf_counter()
     with profiling.timed(label):
         out = _execute_device(
             stacked, template, program.input_regs, program.output_regs,
             instr, mesh,
+        )
+    dt = time.perf_counter() - t0
+    # span-trace plane (obs/tracing.py): VM executions ride the Chrome
+    # trace export next to the serve pipeline's request spans. Opt-in —
+    # the disabled cost is one env read per execute() (device-call scale,
+    # not hot-loop scale).
+    from ..obs import tracing
+
+    if tracing.trace_enabled():
+        tracing.global_tracer().note_execution(
+            steps=program.n_steps, regs=program.n_regs,
+            batch=tuple(batch_shape), sharded=mesh is not None,
+            t0=t0, seconds=dt,
         )
     out = np.asarray(out)
     return {

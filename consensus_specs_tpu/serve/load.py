@@ -14,7 +14,8 @@ latency record that `bench.py --mode serve` prints as its JSON line.
 Env overrides (CPU-sized defaults; a granted TPU window can scale up):
   SERVE_COMMITTEES, SERVE_K, SERVE_EVENTS, SERVE_RATE_HZ,
   SERVE_MAX_BATCH, SERVE_MAX_WAIT_MS, SERVE_INJECT_FAILURE (1/0),
-  SERVE_SEED
+  SERVE_SEED, SERVE_METRICS_PORT (opt-in /metrics + /snapshot + /healthz
+  endpoint during the run; 0 = ephemeral port, reported in the JSON line)
 """
 import os
 import random
@@ -113,6 +114,15 @@ def run_serve_bench(target: float = TARGET_PER_CHIP) -> dict:
     from ..ops import bls_backend
     from .service import VerificationService
 
+    # clean slate: the serve line always attaches profiling.summary(), and
+    # a prior mode's reservoirs/gauges in this process (multi-mode bench
+    # runs, tests) must not bleed into it; the once-per-process vm-cache
+    # gauges are re-published after the wipe
+    from ..obs import programs as obs_programs
+
+    profiling.reset()
+    obs_programs.export_gauges()
+
     # rate sized so a max_wait flush window catches several events (~4 ms
     # apart at 256 Hz): micro-batches then carry >1 unique committee and
     # the RLC combine path actually combines instead of degenerating to
@@ -150,26 +160,66 @@ def run_serve_bench(target: float = TARGET_PER_CHIP) -> dict:
     svc = VerificationService(
         backend=backend, max_batch=max_batch, max_wait_ms=max_wait_ms
     )
-    futures, expected, sig_count = [], [], 0
-    t_start = time.perf_counter()
-    t_next = t_start
-    for ci in picks:
-        pks, msg, sig, ok = committees[ci]
-        futures.append(svc.submit("fast_aggregate", pks, msg, sig))
-        expected.append(ok)
-        sig_count += len(pks)
-        t_next += rng.expovariate(rate_hz)
-        pause = t_next - time.perf_counter()
-        if pause > 0:
-            time.sleep(pause)
-    # bounded wait FIRST, then harvest: calling f.result(timeout=...) in a
-    # loop would raise on the first unresolved future and never reach the
-    # lost-request accounting below
-    import concurrent.futures as cf
+    # opt-in exposition endpoint, live DURING the load (SERVE_METRICS_PORT;
+    # 0 = ephemeral): /metrics Prometheus text, /snapshot ServeMetrics
+    # JSON, /healthz — scraped once mid-load to prove it answers under
+    # fire. The whole load runs under try/finally: the service drains and
+    # the port unbinds even when a submit or the (non-fatal) scrape fails.
+    exposition, scrape = None, None
+    port_env = (os.environ.get("SERVE_METRICS_PORT") or "").strip()
+    try:
+        if port_env:
+            from ..obs.exposition import start_exposition
 
-    _, pending = cf.wait(futures, timeout=600)
-    elapsed = time.perf_counter() - t_start
-    svc.close(timeout=60)
+            exposition = start_exposition(metrics=svc.metrics,
+                                          port=int(port_env))
+        futures, expected, sig_count = [], [], 0
+        t_start = time.perf_counter()
+        t_next = t_start
+        for ci in picks:
+            pks, msg, sig, ok = committees[ci]
+            futures.append(svc.submit("fast_aggregate", pks, msg, sig))
+            expected.append(ok)
+            sig_count += len(pks)
+            t_next += rng.expovariate(rate_hz)
+            pause = t_next - time.perf_counter()
+            if pause > 0:
+                time.sleep(pause)
+        scrape_thread, scrape_box = None, {}
+        if exposition is not None:
+            # the stream is fully submitted but far from drained: this
+            # scrape happens under live traffic — on a HELPER thread, so
+            # a slow/wedged endpoint can never inflate the elapsed window
+            # the sigs/sec headline divides by. A failed scrape is a
+            # recorded observation (scrape stays None), never the reason
+            # the primary measurement dies
+            import threading
+            import urllib.request
+
+            def _scrape():
+                try:
+                    with urllib.request.urlopen(exposition.url("/metrics"),
+                                                timeout=30) as resp:
+                        scrape_box["body"] = resp.read().decode()
+                except Exception:
+                    pass
+
+            scrape_thread = threading.Thread(target=_scrape, daemon=True)
+            scrape_thread.start()
+        # bounded wait FIRST, then harvest: calling f.result(timeout=...)
+        # in a loop would raise on the first unresolved future and never
+        # reach the lost-request accounting below
+        import concurrent.futures as cf
+
+        _, pending = cf.wait(futures, timeout=600)
+        elapsed = time.perf_counter() - t_start
+        if scrape_thread is not None:
+            scrape_thread.join(35)
+            scrape = scrape_box.get("body")
+    finally:
+        svc.close(timeout=60)
+        if exposition is not None:
+            exposition.close()
 
     lost = len(pending)
     results = [bool(f.result()) if f.done() else None for f in futures]
@@ -235,4 +285,8 @@ def run_serve_bench(target: float = TARGET_PER_CHIP) -> dict:
         wrong=wrong,
         profile=profiling.summary(),
     )
+    if exposition is not None:
+        result["metrics_port"] = exposition.port
+        result["metrics_scrape_ok"] = scrape is not None
+        result["metrics_scrape_lines"] = len((scrape or "").splitlines())
     return result

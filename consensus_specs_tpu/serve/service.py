@@ -30,6 +30,14 @@ answered by the result LRU or, while still in flight, by sharing the
 first submitter's Future (`cache.py`) — the backend sees each distinct
 check exactly once.
 
+Observability: every accepted submit can carry a per-request span trace
+(queue-wait / prep / device / RLC-combine / finalize — obs/tracing.py,
+opt-in via CONSENSUS_SPECS_TPU_TRACE=1 or an explicit ``tracer=``), and
+the counters in metrics.py export through ops/profiling into the
+Prometheus ``/metrics`` endpoint (obs/exposition.py). With tracing off the
+service stores None and every stage skips on one ``is not None`` check —
+no locks, allocations, or syscalls are added to the hot path.
+
 NOTE: construct the service OUTSIDE any active SignatureCollector
 context — the default fallback oracle is captured from the bls
 switchboard at __init__ time, and inside a collector those names are the
@@ -43,6 +51,7 @@ from collections import deque
 from concurrent.futures import Future
 from typing import List, Optional
 
+from ..obs import tracing
 from ..ops import profiling
 from .cache import ResultCache, check_key
 from .metrics import ServeMetrics
@@ -69,10 +78,10 @@ class QueueFull(RuntimeError):
 
 class _Pending:
     __slots__ = ("kind", "pubkeys", "messages", "signature", "key",
-                 "bucket", "future", "t_submit")
+                 "bucket", "future", "t_submit", "trace")
 
     def __init__(self, kind, pubkeys, messages, signature, key, bucket,
-                 future, t_submit):
+                 future, t_submit, trace=None):
         self.kind = kind
         self.pubkeys = pubkeys
         self.messages = messages
@@ -81,6 +90,7 @@ class _Pending:
         self.bucket = bucket
         self.future = future
         self.t_submit = t_submit
+        self.trace = trace  # obs.tracing.RequestTrace, or None (tracing off)
 
 
 class _CapturedOracle:
@@ -110,9 +120,14 @@ class VerificationService:
     def __init__(self, backend=None, oracle=None, *, max_batch: int = 256,
                  max_wait_ms: float = 20.0, max_queue: int = 4096,
                  cache_capacity: int = 1 << 16, backend_retries: int = 1,
-                 bucket_fn=None):
+                 bucket_fn=None, tracer=None):
         assert max_batch > 0 and max_queue > 0
         self._backend = backend  # None: resolved lazily on first batch
+        # per-request span tracing (obs/tracing.py): an explicit tracer
+        # wins; otherwise the global tracer iff CONSENSUS_SPECS_TPU_TRACE
+        # is set AT CONSTRUCTION. Disabled == None: every stage guards on
+        # one `is not None` — no new locks or allocations on the hot path.
+        self._tracer = tracer if tracer is not None else tracing.maybe_tracer()
         if oracle is None:
             from ..utils import bls
 
@@ -227,8 +242,11 @@ class VerificationService:
                         f"{timeout}s"
                     )
                 self._not_full.wait(remaining)
+            tr = (self._tracer.begin(kind, len(pubkeys), t0)
+                  if self._tracer is not None else None)
             pend = _Pending(kind, pubkeys, messages, signature, key,
-                            self._bucket_fn(max(1, len(pubkeys))), fut, t0)
+                            self._bucket_fn(max(1, len(pubkeys))), fut, t0,
+                            tr)
             self._queue.append(pend)
             self._inflight[key] = pend
             self.metrics.note_enqueued(len(self._queue))
@@ -289,7 +307,11 @@ class VerificationService:
                 # stage's per-item cache misses re-derive (and re-raise)
                 # whatever prep could not produce
                 profiling.record("serve.prep_error", 0.0)
-            self.metrics.note_prep(time.perf_counter() - t0)
+            t1 = time.perf_counter()
+            self.metrics.note_prep(t1 - t0)
+            if self._tracer is not None:
+                self._tracer.span_many((p.trace for p in batch), "prep",
+                                       t0, t1)
             self._handoff.put(batch)
 
     def _prep(self, batch: List[_Pending]) -> None:
@@ -351,7 +373,12 @@ class VerificationService:
             batch = [self._queue.popleft() for _ in range(n)]
             self._staged += n
             profiling.set_gauge("serve.queue_depth", len(self._queue))
-            return batch
+        if self._tracer is not None:
+            now = time.perf_counter()
+            for p in batch:
+                if p.trace is not None:
+                    self._tracer.span(p.trace, "queue_wait", p.t_submit, now)
+        return batch
 
     def _process(self, batch: List[_Pending]) -> None:
         groups = {}
@@ -369,15 +396,22 @@ class VerificationService:
                     len(pends), sum(len(p.pubkeys) for p in pends), bucket,
                     dt * len(pends) / len(batch),
                 )
+            if self._tracer is not None:
+                self._tracer.span_many((p.trace for p in batch), "device",
+                                       t_flush, t_flush + dt)
             self._settle(batch, results)
         else:
             for (kind, bucket), pends in groups.items():
                 t0 = time.perf_counter()
                 results = self._verify_group(kind, pends)
+                t1 = time.perf_counter()
                 self.metrics.note_batch(
                     len(pends), sum(len(p.pubkeys) for p in pends), bucket,
-                    time.perf_counter() - t0,
+                    t1 - t0,
                 )
+                if self._tracer is not None:
+                    self._tracer.span_many((p.trace for p in pends),
+                                           "device", t0, t1)
                 self._settle(pends, results)
         # whole-flush device time (all groups): the prep/device split is
         # per FLUSH on both sides, so the means share a denominator shape
@@ -402,7 +436,15 @@ class VerificationService:
             if attempt:
                 self.metrics.note_retry()
             try:
-                return [bool(r) for r in rlc_fn(items)]
+                t0 = time.perf_counter()
+                res = [bool(r) for r in rlc_fn(items)]
+                if self._tracer is not None:
+                    # the RLC combined check (bisection included when the
+                    # combine failed and split) — nests inside `device`
+                    self._tracer.span_many((p.trace for p in batch),
+                                           "combine", t0,
+                                           time.perf_counter())
+                return res
             except Exception:
                 pass
         profiling.record("serve.rlc_error", 0.0)
@@ -457,3 +499,9 @@ class VerificationService:
             self.metrics.note_result(now - p.t_submit)
             if not p.future.done():
                 p.future.set_result(bool(r))
+        if self._tracer is not None:
+            t_end = time.perf_counter()
+            for p, r in zip(pends, results):
+                if p.trace is not None:
+                    self._tracer.span(p.trace, "finalize", now, t_end)
+                    self._tracer.finish(p.trace, bool(r), t_end)

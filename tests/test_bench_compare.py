@@ -1,0 +1,137 @@
+"""tools/bench_compare.py — the round-over-round perf regression gate.
+
+Pins the selection/comparability rules on synthetic BENCH_r*.json trees:
+platform-keyed comparison (CPU fallbacks never score against TPU
+windows), per-shape keys, per_mode_best joining, the skip conditions, and
+the exit codes `make bench-compare` turns into a visible failure.
+"""
+import importlib.util
+import json
+import os
+
+import pytest
+
+_TOOL = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     "tools", "bench_compare.py")
+
+
+@pytest.fixture(scope="module")
+def bc():
+    spec = importlib.util.spec_from_file_location("bench_compare_under_test",
+                                                  _TOOL)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write_round(tmp_path, n, parsed):
+    doc = {"n": n, "rc": 0, "parsed": parsed}
+    (tmp_path / f"BENCH_r{n:02d}.json").write_text(json.dumps(doc))
+
+
+def _parsed(value, platform="cpu", mode="committee", n=32, k=128, **extra):
+    out = {"metric": "sigs/sec", "value": value, "vs_baseline": 0.1,
+           "platform": platform, "mode": mode, "n": n, "k": k}
+    out.update(extra)
+    return out
+
+
+def test_ok_within_threshold(tmp_path, bc, capsys):
+    _write_round(tmp_path, 1, _parsed(300.0))
+    _write_round(tmp_path, 2, _parsed(280.0))  # -6.7%
+    assert bc.main(["--dir", str(tmp_path)]) == 0
+    assert "OK" in capsys.readouterr().out
+
+
+def test_regression_past_threshold_fails(tmp_path, bc, capsys):
+    _write_round(tmp_path, 1, _parsed(300.0))
+    _write_round(tmp_path, 2, _parsed(150.0))  # -50%
+    assert bc.main(["--dir", str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "FAIL" in out and "cpu:committee[32x128]" in out
+
+
+def test_threshold_flag_tightens(tmp_path, bc):
+    _write_round(tmp_path, 1, _parsed(300.0))
+    _write_round(tmp_path, 2, _parsed(280.0))  # -6.7%
+    assert bc.main(["--dir", str(tmp_path), "--max-regression", "5"]) == 1
+
+
+def test_improvement_never_fails(tmp_path, bc):
+    _write_round(tmp_path, 1, _parsed(300.0))
+    _write_round(tmp_path, 2, _parsed(900.0))
+    assert bc.main(["--dir", str(tmp_path), "--max-regression", "1"]) == 0
+
+
+def test_platform_mismatch_skips(tmp_path, bc, capsys):
+    """A CPU fallback round after a TPU window is ~10x slower for reasons
+    that say nothing about the code — must SKIP, not FAIL."""
+    _write_round(tmp_path, 1, _parsed(3170.0, platform="tpu"))
+    _write_round(tmp_path, 2, _parsed(325.0, platform="cpu (fallback)"))
+    assert bc.main(["--dir", str(tmp_path)]) == 0
+    assert "SKIP" in capsys.readouterr().out
+
+
+def test_cpu_fallback_compares_against_plain_cpu(tmp_path, bc):
+    _write_round(tmp_path, 1, _parsed(300.0, platform="cpu"))
+    _write_round(tmp_path, 2, _parsed(100.0, platform="cpu (fallback)"))
+    assert bc.main(["--dir", str(tmp_path)]) == 1
+
+
+def test_shape_keys_never_cross(tmp_path, bc):
+    """The 4x8 liveness shape must not be scored against 32x128."""
+    _write_round(tmp_path, 1, _parsed(9000.0, n=4, k=8))
+    _write_round(tmp_path, 2, _parsed(300.0, n=32, k=128))
+    assert bc.main(["--dir", str(tmp_path)]) == 0
+
+
+def test_per_mode_best_joins_comparison(tmp_path, bc, capsys):
+    _write_round(tmp_path, 1, _parsed(
+        300.0, per_mode_best={"committee[32x128]": 300.0, "epoch": 250.0}))
+    _write_round(tmp_path, 2, _parsed(
+        310.0, per_mode_best={"committee[32x128]": 310.0, "epoch": 50.0}))
+    assert bc.main(["--dir", str(tmp_path)]) == 1  # epoch collapsed -80%
+    assert "cpu:epoch" in capsys.readouterr().out
+
+
+def test_newest_without_usable_value_fails(tmp_path, bc, capsys):
+    _write_round(tmp_path, 1, _parsed(300.0))
+    _write_round(tmp_path, 2, {"value": 0.0, "error": "backend init hang"})
+    assert bc.main(["--dir", str(tmp_path)]) == 1
+    assert "no usable parsed value" in capsys.readouterr().out
+
+
+def test_unusable_previous_rounds_are_walked_past(tmp_path, bc, capsys):
+    """An error round in the middle must not mask the last good baseline."""
+    _write_round(tmp_path, 1, _parsed(300.0))
+    _write_round(tmp_path, 2, {"value": 0.0, "error": "window died"})
+    _write_round(tmp_path, 3, _parsed(100.0))
+    assert bc.main(["--dir", str(tmp_path)]) == 1
+    assert "BENCH_r01.json" in capsys.readouterr().out
+
+
+def test_single_round_skips(tmp_path, bc):
+    _write_round(tmp_path, 1, _parsed(300.0))
+    assert bc.main(["--dir", str(tmp_path)]) == 0
+
+
+def test_empty_dir_skips(tmp_path, bc):
+    assert bc.main(["--dir", str(tmp_path)]) == 0
+
+
+def test_round_ordering_is_numeric_not_lexical(tmp_path, bc):
+    """r2 vs r10 must order 2 < 10 (lexical would say '10' < '2')."""
+    _write_round(tmp_path, 2, _parsed(300.0))
+    _write_round(tmp_path, 10, _parsed(100.0))
+    files = bc.round_files(str(tmp_path))
+    assert [os.path.basename(f) for f in files] == [
+        "BENCH_r02.json", "BENCH_r10.json"]
+    assert bc.main(["--dir", str(tmp_path)]) == 1  # r10 regressed vs r02
+
+
+def test_real_repo_rounds_pass(bc, monkeypatch):
+    """The committed BENCH_r*.json history must satisfy its own gate at
+    the DEFAULT threshold (this is the `make bench-compare` invocation CI
+    runs; the ambient env knob must not change the test's meaning)."""
+    monkeypatch.delenv("BENCH_COMPARE_MAX_REGRESSION", raising=False)
+    assert bc.main([]) == 0

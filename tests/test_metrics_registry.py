@@ -1,0 +1,103 @@
+"""Metric-name + env-var drift gate (tier-1).
+
+Every gauge/stat/latency label emitted anywhere in the package must be
+declared in the single registry module (``obs/registry.py``) AND appear in
+the README metric table; every ``CONSENSUS_SPECS_TPU_*`` environment
+variable referenced in the sources must appear in the README env-var
+reference. A rename (or a new metric/env knob) that skips the registry or
+the docs fails here instead of silently orphaning a dashboard, scrape
+rule, or operator playbook.
+"""
+import os
+import re
+
+from consensus_specs_tpu.obs import registry
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_PKG = os.path.join(_ROOT, "consensus_specs_tpu")
+
+# profiling call sites with a literal first-arg label (multi-line allowed:
+# black wraps long calls); labels passed via constants are caught by the
+# *_LABEL assignment pattern below
+_CALL_RE = re.compile(
+    r"profiling\s*\.\s*(?:set_gauge|record_latency|record)\(\s*[\"']([^\"']+)[\"']"
+)
+_LABEL_CONST_RE = re.compile(r"^[A-Z_]*LABEL\s*=\s*\"([^\"]+)\"", re.M)
+_ENV_RE = re.compile(r"CONSENSUS_SPECS_TPU_[A-Z0-9_]+")
+
+
+def _py_sources():
+    for dirpath, dirnames, filenames in os.walk(_PKG):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in filenames:
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+    yield os.path.join(_ROOT, "bench.py")
+
+
+def _emitted_labels():
+    labels = {}
+    for path in _py_sources():
+        with open(path) as fh:
+            text = fh.read()
+        for m in _CALL_RE.finditer(text):
+            labels.setdefault(m.group(1), path)
+        for m in _LABEL_CONST_RE.finditer(text):
+            labels.setdefault(m.group(1), path)
+    return labels
+
+
+def test_every_emitted_label_is_registered():
+    missing = {
+        label: path
+        for label, path in _emitted_labels().items()
+        if not registry.known(label)
+    }
+    assert not missing, (
+        "metric labels emitted but missing from obs/registry.py "
+        f"(add them to GAUGES/STATS/LATENCIES or DYNAMIC_PREFIXES): {missing}"
+    )
+
+
+def test_emitted_labels_were_actually_found():
+    # the scan itself must keep working: the serve plane's known labels
+    # have to show up, else a refactor broke the regexes, not the metrics
+    found = _emitted_labels()
+    for expected in ("serve.queue_depth", "serve.submit_to_result",
+                     "bls.rlc_combines", "bls.vm_cache_hits"):
+        assert expected in found, f"label scan lost {expected}"
+
+
+def test_registry_names_are_documented():
+    with open(os.path.join(_ROOT, "README.md")) as fh:
+        readme = fh.read()
+    undocumented = [n for n in registry.all_names() if f"`{n}`" not in readme]
+    assert not undocumented, (
+        "registered metric names missing from the README metric table: "
+        f"{undocumented}"
+    )
+    for prefix in registry.DYNAMIC_PREFIXES:
+        assert f"`{prefix}" in readme, (
+            f"dynamic metric family {prefix!r} missing from the README "
+            "metric table"
+        )
+
+
+def test_dynamic_prefixes_exist_in_source():
+    # a registered dynamic family must correspond to a real emission site
+    vm_src = open(os.path.join(_PKG, "ops", "vm.py")).read()
+    assert 'f"vm[steps=' in vm_src
+
+
+def test_env_vars_are_documented():
+    with open(os.path.join(_ROOT, "README.md")) as fh:
+        readme = fh.read()
+    referenced = set()
+    for path in _py_sources():
+        with open(path) as fh:
+            referenced.update(_ENV_RE.findall(fh.read()))
+    undocumented = sorted(v for v in referenced if v not in readme)
+    assert not undocumented, (
+        "CONSENSUS_SPECS_TPU_* env vars referenced in sources but missing "
+        f"from the README env-var reference: {undocumented}"
+    )

@@ -1,0 +1,177 @@
+#!/usr/bin/env python
+"""Round-over-round bench regression gate (`make bench-compare`).
+
+The driver records one ``BENCH_r<NN>.json`` per round whose ``parsed``
+block is the headline JSON line ``bench.py`` printed (best-throughput
+stage, with ``per_mode_best`` attaching every (mode, shape) that landed).
+This tool diffs the NEWEST round against the most recent previous round
+that recorded a usable number and exits nonzero when any comparable
+headline regressed more than the allowed fraction — a perf regression
+becomes a visible check failure instead of a silently worse JSON artifact.
+
+Comparability rules:
+- values key by ``platform:shape`` — a CPU-fallback round must never be
+  scored against a TPU window's number (the gap is ~10x and says nothing
+  about the code); ``cpu (fallback)`` and ``cpu`` are the same platform.
+- committee shapes carry their ``[NxK]`` (bench.py `_shape_key` rule: the
+  4x8 liveness shape and the comparable 32x128 shape never share a slot).
+- ``per_mode_best`` entries join the comparison under the parsed line's
+  platform (they all came from the same child process).
+- no common key between the rounds -> SKIP (exit 0, says so); a newest
+  round with NO usable parsed value -> FAIL (a bench that stopped
+  emitting numbers is itself a regression).
+
+Threshold: ``--max-regression`` percent (default: env
+``BENCH_COMPARE_MAX_REGRESSION`` or 30). CPU committee numbers jitter a
+few percent round over round on shared hosts; 30% catches a lost
+optimization without flapping on noise. Improvements never fail.
+"""
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def round_files(directory):
+    """BENCH_r*.json paths sorted by round number."""
+    found = []
+    for path in glob.glob(os.path.join(directory, "BENCH_r*.json")):
+        m = _ROUND_RE.search(os.path.basename(path))
+        if m:
+            found.append((int(m.group(1)), path))
+    return [p for _, p in sorted(found)]
+
+
+def _platform(parsed):
+    plat = str(parsed.get("platform", "unknown"))
+    return "cpu" if plat.startswith("cpu") else plat
+
+
+def _shape_key(parsed):
+    mode = parsed.get("mode", "committee")
+    n, k = parsed.get("n"), parsed.get("k")
+    if mode == "committee" and n and k:
+        return f"committee[{n}x{k}]"
+    return str(mode)
+
+
+def extract(doc):
+    """{``platform:shape``: value} comparables from one round's JSON."""
+    parsed = doc.get("parsed")
+    if not isinstance(parsed, dict) or "error" in parsed:
+        return {}
+    try:
+        value = float(parsed.get("value", 0))
+    except (TypeError, ValueError):
+        return {}
+    if value <= 0:
+        return {}
+    plat = _platform(parsed)
+    out = {f"{plat}:{_shape_key(parsed)}": value}
+    per_mode = parsed.get("per_mode_best")
+    if isinstance(per_mode, dict):
+        for key, v in per_mode.items():
+            try:
+                v = float(v)
+            except (TypeError, ValueError):
+                continue
+            if v > 0:
+                # the headline's own slot keeps the (possibly higher)
+                # parsed value
+                out.setdefault(f"{plat}:{key}", v)
+    return out
+
+
+def _load(path):
+    with open(path) as fh:
+        return json.load(fh)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--dir",
+        default=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        help="directory holding the BENCH_r*.json rounds (default: repo root)",
+    )
+    ap.add_argument(
+        "--max-regression",
+        type=float,
+        default=float(os.environ.get("BENCH_COMPARE_MAX_REGRESSION", "30")),
+        help="allowed headline drop in percent before failing (default 30)",
+    )
+    args = ap.parse_args(argv)
+
+    files = round_files(args.dir)
+    if not files:
+        print("bench-compare: SKIP — no BENCH_r*.json rounds found")
+        return 0
+    newest = files[-1]
+    try:
+        new_vals = extract(_load(newest))
+    except (OSError, ValueError) as e:
+        print(f"bench-compare: FAIL — {os.path.basename(newest)} unreadable: {e}")
+        return 1
+    if not new_vals:
+        print(
+            f"bench-compare: FAIL — newest round {os.path.basename(newest)} "
+            "recorded no usable parsed value (error line or value<=0)"
+        )
+        return 1
+    if len(files) == 1:
+        print("bench-compare: SKIP — only one round; nothing to compare")
+        return 0
+
+    prev_vals, prev_path = {}, None
+    for path in reversed(files[:-1]):
+        try:
+            prev_vals = extract(_load(path))
+        except (OSError, ValueError):
+            prev_vals = {}
+        if prev_vals:
+            prev_path = path
+            break
+    if not prev_vals:
+        print("bench-compare: SKIP — no earlier round recorded a usable value")
+        return 0
+
+    common = sorted(set(new_vals) & set(prev_vals))
+    if not common:
+        print(
+            "bench-compare: SKIP — no comparable (platform, shape) keys "
+            f"between {os.path.basename(prev_path)} "
+            f"({', '.join(sorted(prev_vals))}) and "
+            f"{os.path.basename(newest)} ({', '.join(sorted(new_vals))})"
+        )
+        return 0
+
+    threshold = args.max_regression / 100.0
+    failures = []
+    print(
+        f"bench-compare: {os.path.basename(prev_path)} -> "
+        f"{os.path.basename(newest)} (allowed regression "
+        f"{args.max_regression:.0f}%)"
+    )
+    for key in common:
+        old, new = prev_vals[key], new_vals[key]
+        delta = (new - old) / old
+        marker = "  REGRESSION" if delta < -threshold else ""
+        print(f"  {key}: {old:.2f} -> {new:.2f} ({delta:+.1%}){marker}")
+        if delta < -threshold:
+            failures.append(key)
+    if failures:
+        print(
+            f"bench-compare: FAIL — headline regressed more than "
+            f"{args.max_regression:.0f}% on: {', '.join(failures)}"
+        )
+        return 1
+    print(f"bench-compare: OK — {len(common)} comparable key(s) within bounds")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
