@@ -18,7 +18,7 @@ CHEAP_GENERATORS = shuffling bls ssz_generic merkle
 
 .PHONY: test citest test_tpu_backend lint generate_tests \
         detect_generator_incomplete check_vectors bench serve-bench codec-bench multichip \
-        clean_vectors generate_random_tests bench-compare check serve-trace
+        clean_vectors generate_random_tests bench-compare check serve-trace head-bench docs
 
 # fast default: BLS stubbed except @always_bls, 4-way process-parallel
 # (reference `make test` = pytest -n 4, reference Makefile:100)
@@ -115,6 +115,15 @@ serve-trace:
 # the JSON line's vs_baseline field is the batched-over-per-item speedup
 codec-bench:
 	JAX_PLATFORMS=cpu python bench.py --mode codec
+
+# chain-plane bench: synthetic fork-and-gossip replay through the
+# HeadService + incremental proto-array vs the spec-store get_head
+# recompute, at growing block-tree sizes (HEAD_TREE_SIZES env); fault
+# injection covers invalid-signature and withheld-block (deferred-then-
+# resolved) gossip, and the ephemeral /metrics endpoint is scraped
+# mid-replay so the JSON line proves the chain.* gauges answer under load
+head-bench:
+	JAX_PLATFORMS=cpu SERVE_METRICS_PORT=0 python bench.py --mode head
 
 # final-exp microbenchmark: per-item easy+hard finalization vs the RLC
 # combine (one final exponentiation per batch) on identical Miller

@@ -59,6 +59,18 @@ CODEC_ITEMS items per kind — no pairings, just the front-door cost.
 finalization vs the random-linear-combination combine
 (bls_backend.batch_verify_rlc's core) on identical Miller outputs,
 items/sec across N in {4,16,64,256} (RLC_BENCH_* env).
+
+`--mode head` is the chain-plane bench: a synthetic fork-and-gossip
+replay (consensus_specs_tpu/bench/head_replay.py) through the
+HeadService + proto-array vs the spec-store `get_head` recompute, at
+growing block-tree sizes (HEAD_TREE_SIZES). The JSON line's value is
+proto-array heads/sec at the largest tree; `vs_baseline` is the measured
+speedup over the spec path divided by the 10x acceptance bar; per-tree
+numbers ride `per_mode_best` as `head[<blocks>]` keys so
+tools/bench_compare.py diffs them round over round. Fault injection
+(invalid-signature + withheld-block deferred gossip) comes from
+serve/load.py; SERVE_METRICS_PORT exposes /metrics mid-replay and the
+line records the `chain.*` scrape.
 """
 import json
 import os
@@ -425,6 +437,18 @@ def main():
         from consensus_specs_tpu.bench.codec_prep import run_codec_bench
 
         _emit_result(run_codec_bench())
+        return
+
+    if _cli_mode() == "head":
+        # chain-plane replay: proto-array vs spec-store get_head. CPU-
+        # forced — the acceptance bar is the maintained pointer beating
+        # the spec recompute >= 10x at the largest tree on plain CPU
+        from consensus_specs_tpu.utils.jax_env import force_cpu
+
+        force_cpu()
+        from consensus_specs_tpu.bench.head_replay import run_head_bench
+
+        _emit_result(run_head_bench())
         return
 
     if _cli_mode() == "rlc":

@@ -52,6 +52,8 @@ SOURCES = {
         "fork_choice.py",
         "fork.py",
         "validator.py",
+        "p2p.py",
+        "client_settings.py",
     ],
     "sharding": [
         "beacon_chain.py",

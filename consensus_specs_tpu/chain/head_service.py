@@ -1,0 +1,423 @@
+"""HeadService: gossip in, O(1) ``get_head()`` out.
+
+This is the subsystem the serve plane was missing a consumer for: the
+streaming verifier (``serve/service.py``) can sustain gossip-rate
+signature checks, but verified attestations went nowhere. HeadService
+closes the loop:
+
+  on_block / on_attestations (gossip) ──> structural validation against
+  the spec Store ──> signature checks routed through a
+  ``VerificationService`` (micro-batched, deduped, RLC-combined) ──>
+  verified latest-message updates applied to BOTH the spec ``Store``
+  (the oracle) and the incremental proto-array (the production path)
+  ──> one reverse sweep per batch ──> ``get_head()`` reads a pointer.
+
+The spec ``Store`` is not a shadow — it IS the state source: blocks run
+the real ``spec.on_block`` (state transition, checkpoint promotion),
+attestations run the real validation pipeline with exactly one
+substitution: ``is_valid_indexed_attestation``'s BLS check goes through
+the verification service instead of inline crypto. The proto-array is a
+derived index over that store, which is what makes the differential gate
+meaningful: ``spec.get_head(store)`` recomputed from scratch must equal
+the maintained pointer after every mutation batch
+(``differential=True`` / ``CONSENSUS_SPECS_TPU_CHAIN_DIFF=1`` asserts it
+inline; tests/test_chain*.py gate it).
+
+Gossip reality is handled the way real clients do:
+- attestations for **unknown blocks** or **future slots/epochs** are
+  parked in a bounded deferral buffer and retried when a block arrives
+  or the clock ticks ("delay consideration", fork-choice.md);
+- attestations with **invalid signatures**, inconsistent FFG/LMD votes,
+  or malformed committees are dropped and counted;
+- everything observable exports through ``chain.*`` metrics
+  (obs/registry.py) and per-batch spans (validate / sig_wait / apply /
+  sweep) on the request tracer when tracing is enabled.
+
+Threading contract: one mutator at a time (a gossip loop), matching the
+spec Store's own single-writer shape. Reads (``get_head``,
+``head_slot``) are plain attribute loads.
+"""
+import os
+import time
+from collections import deque
+from typing import List, Optional, Tuple
+
+from ..obs import tracing
+from .metrics import ChainMetrics
+from .proto_array import ProtoForkChoice
+
+DIFF_ENV = "CONSENSUS_SPECS_TPU_CHAIN_DIFF"
+
+# attestation routing verdicts (metrics buckets + deferral control)
+OK, DEFER, DROP = "ok", "defer", "drop"
+
+
+def _cp(checkpoint) -> Tuple[int, bytes]:
+    return (int(checkpoint.epoch), bytes(checkpoint.root))
+
+
+class _Verdict:
+    """Future-shaped immediate result (the no-service verification path)."""
+
+    __slots__ = ("_value",)
+
+    def __init__(self, value: bool):
+        self._value = value
+
+    def result(self, timeout=None) -> bool:
+        return self._value
+
+
+class _Prepared:
+    __slots__ = ("attestation", "indices", "future")
+
+    def __init__(self, attestation, indices, future):
+        self.attestation = attestation
+        self.indices = indices
+        self.future = future
+
+
+class HeadService:
+    """Incremental fork choice behind the streaming verifier.
+
+    ``spec`` is a built spec module; ``anchor_state``/``anchor_block``
+    boot the store exactly like ``spec.get_forkchoice_store``. ``service``
+    is a ``serve.VerificationService`` (or None: signatures verify
+    through the spec's own BLS switchboard, honoring ``bls_active``).
+    """
+
+    def __init__(self, spec, anchor_state, anchor_block, *, service=None,
+                 metrics: Optional[ChainMetrics] = None, tracer=None,
+                 differential: Optional[bool] = None,
+                 max_deferred: int = 4096, defer_retries: int = 8,
+                 verify_timeout: float = 120.0):
+        self.spec = spec
+        self.store = spec.get_forkchoice_store(anchor_state, anchor_block)
+        self._service = service
+        self.metrics = metrics or ChainMetrics()
+        self._tracer = tracer if tracer is not None else tracing.maybe_tracer()
+        if differential is None:
+            differential = os.environ.get(DIFF_ENV, "0") not in ("", "0")
+        self._differential = differential
+        self._max_deferred = max_deferred
+        self._defer_retries = defer_retries
+        self._verify_timeout = verify_timeout
+        self._deferred: "deque[Tuple[object, int]]" = deque()
+
+        self.fc = ProtoForkChoice()
+        anchor_root = bytes(spec.hash_tree_root(anchor_block))
+        anchor_state_stored = self.store.block_states[
+            spec.hash_tree_root(anchor_block)
+        ]
+        self.fc.on_block(
+            anchor_root, None, int(anchor_block.slot),
+            _cp(anchor_state_stored.current_justified_checkpoint),
+            _cp(anchor_state_stored.finalized_checkpoint),
+        )
+        self._cp_key = None
+        self._refresh_checkpoints()
+        self.fc.apply()
+        self._head = self.fc.head()
+        self._head_slot = int(anchor_block.slot)
+        self.metrics.note_head(int(anchor_block.slot), changed=False,
+                               reorg_depth=0)
+        self.metrics.export_gauges(tracked_blocks=self.fc.block_count)
+
+    # -- reading -------------------------------------------------------------
+
+    def get_head(self):
+        """The maintained head root, O(1). Bit-identical to
+        ``spec.get_head(store)`` — the differential gate's claim."""
+        return self.spec.Root(self._head)
+
+    @property
+    def head_slot(self) -> int:
+        # cached next to the head pointer (NOT derived through the array:
+        # between a pruning refresh and the batch's head update the old
+        # head may be untracked, and readers must stay plain loads)
+        return self._head_slot
+
+    @property
+    def deferred_count(self) -> int:
+        return len(self._deferred)
+
+    # -- gossip ingress ------------------------------------------------------
+
+    def on_tick(self, time_: int) -> None:
+        """Clock advance; may promote the justified checkpoint (epoch
+        boundary) and unlock deferred future-slot attestations."""
+        before = self.spec.get_current_slot(self.store)
+        self.spec.on_tick(self.store, self.spec.uint64(int(time_)))
+        slot_advanced = self.spec.get_current_slot(self.store) != before
+        checkpoint_moved = self._refresh_checkpoints()
+        retry = []
+        if slot_advanced and self._deferred:
+            retry = list(self._deferred)
+            self._deferred.clear()
+        if retry or checkpoint_moved:
+            self._ingest_batch([], retries=retry)
+
+    def on_block(self, signed_block, process_attestations: bool = True) -> None:
+        """Full spec ``on_block`` (state transition included), then the
+        proto-array insert and one batch apply covering the block body's
+        attestations plus any deferred gossip the new block resolves.
+        Invalid blocks raise exactly as the spec does — and leave both
+        the store and the array untouched."""
+        spec, store = self.spec, self.store
+        spec.on_block(store, signed_block)  # raises on invalid
+        block = signed_block.message
+        root = spec.hash_tree_root(block)
+        state = store.block_states[root]
+        self.fc.on_block(
+            bytes(root), bytes(block.parent_root), int(block.slot),
+            _cp(state.current_justified_checkpoint),
+            _cp(state.finalized_checkpoint),
+        )
+        self.metrics.note_block()
+        self._refresh_checkpoints()
+        batch = list(block.body.attestations) if process_attestations else []
+        retry = list(self._deferred)
+        self._deferred.clear()
+        self._ingest_batch(batch, retries=retry)
+
+    def on_attestation(self, attestation) -> dict:
+        return self.on_attestations([attestation])
+
+    def on_attestations(self, attestations) -> dict:
+        """One gossip micro-batch: validate → verify (batched through the
+        service) → apply → one sweep. Returns the routing summary."""
+        return self._ingest_batch(list(attestations))
+
+    # -- pipeline ------------------------------------------------------------
+
+    def _classify(self, attestation) -> str:
+        """The spec's ``validate_on_attestation`` checks, split into
+        "apply now" / "delay consideration" (the spec's own wording for
+        unknown blocks and future slots/epochs) / "never valid"."""
+        spec, store = self.spec, self.store
+        data = attestation.data
+        target = data.target
+        current_epoch = spec.compute_epoch_at_slot(spec.get_current_slot(store))
+        previous_epoch = (current_epoch - 1 if current_epoch > spec.GENESIS_EPOCH
+                          else spec.GENESIS_EPOCH)
+        if target.epoch not in (current_epoch, previous_epoch):
+            return DEFER if target.epoch > current_epoch else DROP
+        if target.epoch != spec.compute_epoch_at_slot(data.slot):
+            return DROP
+        if target.root not in store.blocks:
+            return DEFER
+        if data.beacon_block_root not in store.blocks:
+            return DEFER
+        if store.blocks[data.beacon_block_root].slot > data.slot:
+            return DROP
+        target_slot = spec.compute_start_slot_at_epoch(target.epoch)
+        if target.root != spec.get_ancestor(store, data.beacon_block_root,
+                                            target_slot):
+            return DROP
+        if spec.get_current_slot(store) < data.slot + 1:
+            return DEFER
+        return OK
+
+    def _prepare(self, attestation) -> Optional[_Prepared]:
+        """Index the attestation against its target checkpoint state and
+        submit the signature check. Returns None for structurally invalid
+        committees (the spec's non-crypto ``is_valid_indexed_attestation``
+        half)."""
+        spec, store = self.spec, self.store
+        target = attestation.data.target
+        try:
+            spec.store_target_checkpoint_state(store, target)
+            target_state = store.checkpoint_states[target]
+            indexed = spec.get_indexed_attestation(target_state, attestation)
+        except Exception:
+            return None  # malformed committee coordinates
+        indices = list(indexed.attesting_indices)
+        if not indices or indices != sorted(set(indices)):
+            return None
+        pubkeys = [target_state.validators[i].pubkey for i in indices]
+        domain = spec.get_domain(target_state, spec.DOMAIN_BEACON_ATTESTER,
+                                 target.epoch)
+        signing_root = bytes(spec.compute_signing_root(indexed.data, domain))
+        signature = bytes(attestation.signature)
+        if self._service is not None:
+            future = self._service.submit("fast_aggregate", pubkeys,
+                                          signing_root, signature)
+        else:
+            future = _Verdict(bool(spec.bls.FastAggregateVerify(
+                pubkeys, signing_root, signature)))
+        return _Prepared(attestation, indices, future)
+
+    def _ingest_batch(self, attestations: List, retries: List = ()) -> dict:
+        """The per-batch pipeline shared by every ingress path. ``retries``
+        carries (attestation, attempts) deferral entries riding along."""
+        t0 = time.perf_counter()
+        trace = None
+        if self._tracer is not None:
+            trace = self._tracer.begin("chain_apply",
+                                       len(attestations) + len(retries), t0)
+        summary = {"applied": 0, "stale": 0, "deferred": 0, "dropped": 0,
+                   "resolved": 0}
+        prepared: List[Tuple[_Prepared, bool]] = []  # (item, was_deferred)
+
+        def route(att, attempts, was_deferred):
+            verdict = self._classify(att)
+            if verdict == OK:
+                item = self._prepare(att)
+                if item is None:
+                    summary["dropped"] += 1
+                    self.metrics.note_dropped()
+                else:
+                    prepared.append((item, was_deferred))
+            elif verdict == DEFER and attempts < self._defer_retries \
+                    and len(self._deferred) < self._max_deferred:
+                self._deferred.append((att, attempts + 1))
+                summary["deferred"] += 1
+                self.metrics.note_deferred(len(self._deferred))
+            else:  # never valid, retries exhausted, or buffer full
+                summary["dropped"] += 1
+                self.metrics.note_dropped()
+
+        for att in attestations:
+            route(att, 0, was_deferred=False)
+        for att, attempts in retries:
+            route(att, attempts, was_deferred=True)
+        t1 = time.perf_counter()
+
+        # the whole batch's signature checks are in the service's
+        # micro-batching pipeline now; collect verdicts
+        verified: List[Tuple[_Prepared, bool]] = []
+        for item, was_deferred in prepared:
+            try:
+                ok = bool(item.future.result(timeout=self._verify_timeout))
+            except Exception:
+                ok = False  # service backpressure/close counts as a drop
+            if ok:
+                verified.append((item, was_deferred))
+            else:
+                summary["dropped"] += 1
+                self.metrics.note_dropped()
+        t2 = time.perf_counter()
+
+        for item, was_deferred in verified:
+            applied = self._apply_latest_messages(item)
+            if applied:
+                summary["applied"] += applied
+                self.metrics.note_applied(applied)
+            else:
+                summary["stale"] += 1
+                self.metrics.note_stale()
+            if was_deferred:
+                summary["resolved"] += 1
+                self.metrics.note_resolved(len(self._deferred))
+        t3 = time.perf_counter()
+
+        self.fc.apply()
+        self._update_head()
+        t4 = time.perf_counter()
+        self.metrics.note_batch(t4 - t0)
+        self.metrics.export_gauges(tracked_blocks=self.fc.block_count)
+        if trace is not None:
+            self._tracer.span(trace, "validate", t0, t1)
+            self._tracer.span(trace, "sig_wait", t1, t2)
+            self._tracer.span(trace, "apply", t2, t3)
+            self._tracer.span(trace, "sweep", t3, t4)
+            self._tracer.finish(trace, True, t4)
+        if self._differential:
+            self._assert_spec_head()
+        return summary
+
+    def _apply_latest_messages(self, item: _Prepared) -> int:
+        """Mirror ``spec.update_latest_messages`` into both tables; returns
+        how many validators' latest messages actually moved."""
+        att = item.attestation
+        target_epoch = int(att.data.target.epoch)
+        root = bytes(att.data.beacon_block_root)
+        moved = 0
+        for i in item.indices:
+            if self.fc.on_latest_message(int(i), root, target_epoch):
+                moved += 1
+        self.spec.update_latest_messages(self.store, item.indices, att)
+        return moved
+
+    def _refresh_checkpoints(self) -> bool:
+        """Sync the array's viability/balance inputs with the store's
+        (possibly just-moved) justified/finalized checkpoints."""
+        spec, store = self.spec, self.store
+        jc, fin = store.justified_checkpoint, store.finalized_checkpoint
+        key = (_cp(jc), _cp(fin))
+        if key == self._cp_key:
+            return False
+        # the balance source the spec's weight sum reads; materialize it
+        # if no attestation has targeted this checkpoint yet (the spec's
+        # own get_head needs the same entry to exist)
+        spec.store_target_checkpoint_state(store, jc)
+        state = store.checkpoint_states[jc]
+        active = spec.get_active_validator_indices(
+            state, spec.get_current_epoch(state))
+        balances = {
+            int(i): int(state.validators[i].effective_balance) for i in active
+        }
+        pruned = self.fc.update_checkpoints(_cp(jc), _cp(fin), balances)
+        if pruned:
+            self.metrics.note_pruned(pruned)
+        self._cp_key = key
+        return True
+
+    def _update_head(self) -> None:
+        new_head = self.fc.head()
+        if new_head == self._head:
+            self.metrics.note_head(self._head_slot, changed=False,
+                                   reorg_depth=0)
+            return
+        depth = self.fc.array.reorg_depth(self._head, new_head)
+        self._head = new_head
+        self._head_slot = self.fc.array.node(new_head).slot
+        self.metrics.note_head(self._head_slot, changed=True,
+                               reorg_depth=depth)
+
+    def _assert_spec_head(self) -> None:
+        spec_head = bytes(self.spec.get_head(self.store))
+        if spec_head != self._head:
+            raise AssertionError(
+                "proto-array head diverged from the spec oracle: "
+                f"proto={self._head.hex()[:16]} spec={spec_head.hex()[:16]} "
+                f"(blocks={self.fc.block_count}, "
+                f"justified={self.store.justified_checkpoint.epoch})"
+            )
+
+    # -- synthetic replay ----------------------------------------------------
+
+    def import_block_unchecked(self, block, state=None,
+                               resolve: bool = False) -> None:
+        """Replay/bench ingress: register a block WITHOUT running the state
+        transition (the synthetic fork replays in ``bench/head_replay.py``
+        build trees whose states are crafted, not computed). Never use on
+        a live store — ``on_block`` is the validated path. ``resolve``
+        additionally retries deferred gossip and sweeps (a block arrival
+        on the validated path always does); bulk imports leave it off and
+        call ``resweep()`` once."""
+        spec, store = self.spec, self.store
+        root = spec.hash_tree_root(block)
+        if root in store.blocks:
+            return
+        store.blocks[root] = block
+        if state is not None:
+            store.block_states[root] = state
+            cps = (_cp(state.current_justified_checkpoint),
+                   _cp(state.finalized_checkpoint))
+        else:
+            cps = (_cp(store.justified_checkpoint),
+                   _cp(store.finalized_checkpoint))
+        self.fc.on_block(bytes(root), bytes(block.parent_root),
+                         int(block.slot), *cps)
+        self.metrics.note_block()
+        if resolve:
+            retry = list(self._deferred)
+            self._deferred.clear()
+            self._ingest_batch([], retries=retry)
+
+    def resweep(self) -> None:
+        """Force one sweep + head refresh (after bulk unchecked imports)."""
+        self.fc.apply()
+        self._update_head()
+        self.metrics.export_gauges(tracked_blocks=self.fc.block_count)

@@ -1,0 +1,135 @@
+"""Chain-plane observability: head movement, reorg shape, attestation
+routing outcomes, and apply-batch latency.
+
+Counters live on the owning :class:`HeadService`; the derived values
+export through ``ops/profiling`` (the ``chain.*`` family in
+``obs/registry.py``) so ``/metrics`` scrapes and bench JSON lines carry
+the chain numbers the same way they carry the serve plane's.
+"""
+import threading
+from typing import Dict
+
+from ..ops import profiling
+
+APPLY_LABEL = "chain.apply_batch"
+
+# the gauge family, in export order (the obs drift gate scans this tuple:
+# every name must be registered in obs/registry.py and documented in the
+# README metric table)
+GAUGE_LABELS = (
+    "chain.blocks",
+    "chain.head_slot",
+    "chain.head_changes",
+    "chain.reorgs",
+    "chain.last_reorg_depth",
+    "chain.applied_attestations",
+    "chain.deferred_attestations",
+    "chain.dropped_attestations",
+    "chain.deferred_pending",
+)
+
+
+class ChainMetrics:
+    """Counters for one HeadService instance."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.blocks = 0
+        self.batches = 0
+        self.applied = 0       # attestations that updated a latest message
+        self.stale = 0         # verified but older than the known vote
+        self.deferred = 0      # parked for a missing block / future slot
+        self.dropped = 0       # invalid signature / non-viable / overflow
+        self.resolved = 0      # deferred entries that later applied
+        self.head_changes = 0
+        self.reorgs = 0        # head changes that were not simple extensions
+        self.last_reorg_depth = 0
+        self.head_slot = 0
+        self.deferred_pending = 0
+        self.pruned_nodes = 0
+
+    # -- recording hooks (head_service.py) ----------------------------------
+
+    def note_block(self) -> None:
+        with self._lock:
+            self.blocks += 1
+
+    def note_applied(self, n: int = 1) -> None:
+        with self._lock:
+            self.applied += n
+
+    def note_stale(self, n: int = 1) -> None:
+        with self._lock:
+            self.stale += n
+
+    def note_deferred(self, pending: int) -> None:
+        with self._lock:
+            self.deferred += 1
+            self.deferred_pending = pending
+
+    def note_resolved(self, pending: int, n: int = 1) -> None:
+        with self._lock:
+            self.resolved += n
+            self.deferred_pending = pending
+
+    def note_dropped(self, n: int = 1) -> None:
+        with self._lock:
+            self.dropped += n
+
+    def note_pruned(self, n: int) -> None:
+        with self._lock:
+            self.pruned_nodes += n
+
+    def note_batch(self, seconds: float) -> None:
+        with self._lock:
+            self.batches += 1
+        profiling.record_latency(APPLY_LABEL, seconds)
+
+    def note_head(self, slot: int, changed: bool, reorg_depth: int) -> None:
+        with self._lock:
+            self.head_slot = int(slot)
+            if changed:
+                self.head_changes += 1
+            if reorg_depth > 0:
+                self.reorgs += 1
+                self.last_reorg_depth = reorg_depth
+
+    # -- export --------------------------------------------------------------
+
+    def export_gauges(self, tracked_blocks: int = None) -> None:
+        """Publish the chain family into ``profiling.summary()`` (and so
+        onto ``/metrics``). Values line up with ``GAUGE_LABELS``."""
+        with self._lock:
+            values = (
+                self.blocks if tracked_blocks is None else tracked_blocks,
+                self.head_slot,
+                self.head_changes,
+                self.reorgs,
+                self.last_reorg_depth,
+                self.applied,
+                self.deferred,
+                self.dropped,
+                self.deferred_pending,
+            )
+        for label, value in zip(GAUGE_LABELS, values):
+            profiling.set_gauge(label, value)
+
+    def snapshot(self) -> Dict[str, float]:
+        lat = profiling.latency_summary().get(APPLY_LABEL, {})
+        with self._lock:
+            return {
+                "blocks": self.blocks,
+                "batches": self.batches,
+                "applied": self.applied,
+                "stale": self.stale,
+                "deferred": self.deferred,
+                "resolved": self.resolved,
+                "dropped": self.dropped,
+                "head_changes": self.head_changes,
+                "reorgs": self.reorgs,
+                "last_reorg_depth": self.last_reorg_depth,
+                "head_slot": self.head_slot,
+                "deferred_pending": self.deferred_pending,
+                "pruned_nodes": self.pruned_nodes,
+                "apply_latency": lat,
+            }

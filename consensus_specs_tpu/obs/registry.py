@@ -45,6 +45,18 @@ GAUGES: Dict[str, str] = {
                          "disk cache this process",
     "bls.vm_cache_misses": "VM programs that had to pay host assembly "
                            "(list scheduling) this process",
+    "chain.blocks": "blocks tracked by the proto-array (post-pruning)",
+    "chain.head_slot": "slot of the maintained fork-choice head",
+    "chain.head_changes": "head pointer moves since service start",
+    "chain.reorgs": "head moves that rolled back at least one slot",
+    "chain.last_reorg_depth": "slots rolled back by the most recent reorg",
+    "chain.applied_attestations": "verified attestations that moved a "
+                                  "latest message",
+    "chain.deferred_attestations": "attestations parked for a missing "
+                                   "block / future slot (cumulative)",
+    "chain.dropped_attestations": "attestations rejected: bad signature, "
+                                  "non-viable vote, or retries exhausted",
+    "chain.deferred_pending": "deferral buffer depth right now",
 }
 
 STATS: Dict[str, str] = {
@@ -65,6 +77,8 @@ STATS: Dict[str, str] = {
 LATENCIES: Dict[str, str] = {
     "serve.submit_to_result": "submit()->Future-resolution latency "
                               "(p50/p95/p99 over a bounded reservoir)",
+    "chain.apply_batch": "per-gossip-batch apply latency: validate + "
+                         "signature wait + latest-message apply + sweep",
 }
 
 # dynamic label families: labels built at runtime with a shape/program

@@ -37,6 +37,12 @@ TRACE_ENV = "CONSENSUS_SPECS_TPU_TRACE"
 # surface of the serve trace; `combine` only appears on RLC-routed flushes)
 STAGES = ("queue_wait", "prep", "device", "combine", "finalize")
 
+# the chain plane's per-gossip-batch stages (chain/head_service.py traces
+# one `chain_apply` record per batch: structural validation, the wait on
+# the verification service's batched signature verdicts, latest-message
+# application, and the proto-array's reverse sweep)
+CHAIN_STAGES = ("validate", "sig_wait", "apply", "sweep")
+
 
 def trace_enabled() -> bool:
     """Dynamic env check — flipping the env after import takes effect on

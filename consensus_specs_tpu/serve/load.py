@@ -68,6 +68,64 @@ class FailingBackendProxy:
         return self._backend.prewarm_host_caches(*args, **kwargs)
 
 
+# -- chain-plane gossip fault injection ---------------------------------------
+#
+# The head replay (bench/head_replay.py) and the chain service tests drive
+# attestation gossip through the SAME VerificationService machinery as the
+# signature bench above, but the thing under test is the fork-choice plane,
+# not the pairing math — so the verdicts come from a deterministic
+# crypto-free backend and the faults are planned per event:
+#   "invalid_sig"  the attestation carries BAD_SIGNATURE; the service must
+#                  answer False and the chain plane must DROP it;
+#   "orphan"       the attestation references a block withheld from the
+#                  stream; the chain plane must DEFER it and apply it only
+#                  once the block arrives (deferred-then-resolved).
+
+BAD_SIGNATURE = b"\xba" * 96  # the injected invalid-signature marker
+
+
+class VerdictBackend:
+    """Crypto-free batched backend: the verdict rides IN the signature
+    bytes (``BAD_SIGNATURE`` -> False, anything else -> True), so chain
+    replays exercise the full service pipeline — batching, dedup, caching,
+    False-verdict routing — without paying pairings for synthetic votes.
+    Counts calls/items like the real backend's CALL_COUNTS ledger."""
+
+    def __init__(self):
+        self.calls = 0
+        self.items = 0
+
+    def _verdicts(self, signatures):
+        self.calls += 1
+        self.items += len(signatures)
+        return [sig != BAD_SIGNATURE for sig in signatures]
+
+    def batch_fast_aggregate_verify(self, pubkey_sets, messages, signatures):
+        return self._verdicts([bytes(s) for s in signatures])
+
+    def batch_aggregate_verify(self, pubkey_sets, message_sets, signatures):
+        return self._verdicts([bytes(s) for s in signatures])
+
+
+def plan_gossip_faults(rng: random.Random, events: int,
+                       invalid_rate: float = 0.0,
+                       orphan_rate: float = 0.0):
+    """Per-event fault plan for an attestation gossip replay: a list of
+    "ok" / "invalid_sig" / "orphan" drawn independently per event. The
+    first event is always clean so a replay never starts with an empty
+    applied set."""
+    plan = []
+    for e in range(events):
+        draw = rng.random()
+        if e and draw < invalid_rate:
+            plan.append("invalid_sig")
+        elif e and draw < invalid_rate + orphan_rate:
+            plan.append("orphan")
+        else:
+            plan.append("ok")
+    return plan
+
+
 def build_committees(n_committees: int, k: int, seed: int = 7
                      ) -> List[Tuple[list, bytes, bytes, bool]]:
     """(pubkeys, message, signature, expected) per committee. The last

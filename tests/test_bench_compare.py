@@ -94,6 +94,29 @@ def test_per_mode_best_joins_comparison(tmp_path, bc, capsys):
     assert "cpu:epoch" in capsys.readouterr().out
 
 
+def test_head_mode_keys_by_tree_size(tmp_path, bc, capsys):
+    """`--mode head` lines key as head[<blocks>] (matching the keys the
+    head bench emits in per_mode_best), so a 64-block tree's heads/sec
+    never scores against a 1024-block tree's — and the per-tree
+    per_mode_best entries diff round over round."""
+    head_line = _parsed(
+        1_500_000.0, mode="head", n=None, k=None, blocks=1024,
+        per_mode_best={"head[64]": 1_800_000.0, "head[1024]": 1_500_000.0})
+    assert bc._shape_key(head_line) == "head[1024]"
+    _write_round(tmp_path, 1, head_line)
+    worse = _parsed(
+        800_000.0, mode="head", n=None, k=None, blocks=1024,
+        per_mode_best={"head[64]": 1_700_000.0, "head[1024]": 800_000.0})
+    _write_round(tmp_path, 2, worse)
+    assert bc.main(["--dir", str(tmp_path)]) == 1  # 47% drop at 1024
+    out = capsys.readouterr().out
+    assert "cpu:head[1024]" in out and "cpu:head[64]" in out
+    # a different tree size is a different key, never compared
+    _write_round(tmp_path, 3, _parsed(5.0, mode="head", n=None, k=None,
+                                      blocks=4096))
+    assert bc.main(["--dir", str(tmp_path)]) == 0
+
+
 def test_newest_without_usable_value_fails(tmp_path, bc, capsys):
     _write_round(tmp_path, 1, _parsed(300.0))
     _write_round(tmp_path, 2, {"value": 0.0, "error": "backend init hang"})

@@ -1,0 +1,350 @@
+"""Differential gate for the incremental proto-array fork choice.
+
+The spec ``get_head`` (O(blocks × validators) recompute) is the oracle;
+the proto-array (``chain/proto_array.py``) is the production path. A
+:class:`Mirror` drives BOTH from one randomized event stream — block
+inserts, latest-message batches, justified-checkpoint moves with
+balance-set changes, finalization with pruning — and asserts
+bit-identical heads after EVERY mutation batch. Tier-1 runs small trees;
+``--run-slow`` runs 64+-block trees with >1k vote updates
+(``@pytest.mark.slow`` keeps the tier-1 budget flat).
+"""
+import random
+
+import pytest
+
+from consensus_specs_tpu.builder import build_spec_module
+from consensus_specs_tpu.chain.proto_array import ProtoArray, ProtoForkChoice
+from consensus_specs_tpu.test import context
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return build_spec_module("phase0", "minimal")
+
+
+@pytest.fixture(scope="module")
+def genesis_state(spec):
+    return context.get_genesis_state(
+        spec, context.default_balances, context.default_activation_threshold
+    )
+
+
+# -- the differential mirror --------------------------------------------------
+
+
+class Mirror:
+    """One event stream, two fork choices: every mutation lands in the
+    spec ``Store`` (oracle) and the :class:`ProtoForkChoice` (production),
+    and ``check()`` asserts their heads agree."""
+
+    def __init__(self, spec, genesis_state, rng):
+        self.spec = spec
+        self.rng = rng
+        self.anchor_state = genesis_state.copy()
+        self.anchor_block = spec.BeaconBlock(
+            state_root=self.anchor_state.hash_tree_root())
+        self.store = spec.get_forkchoice_store(self.anchor_state,
+                                               self.anchor_block)
+        self.anchor_root = spec.hash_tree_root(self.anchor_block)
+        self.fc = ProtoForkChoice()
+        anchor_stored = self.store.block_states[self.anchor_root]
+        self.fc.on_block(
+            bytes(self.anchor_root), None, 0,
+            self._cp(anchor_stored.current_justified_checkpoint),
+            self._cp(anchor_stored.finalized_checkpoint),
+        )
+        self.roots = [self.anchor_root]
+        self.refresh()
+
+    @staticmethod
+    def _cp(checkpoint):
+        return (int(checkpoint.epoch), bytes(checkpoint.root))
+
+    def refresh(self):
+        """Re-sync the proto side's balance/viability inputs from the
+        store's checkpoints (what HeadService does after every move)."""
+        spec, store = self.spec, self.store
+        state = store.checkpoint_states[store.justified_checkpoint]
+        active = spec.get_active_validator_indices(
+            state, spec.get_current_epoch(state))
+        balances = {
+            int(i): int(state.validators[i].effective_balance) for i in active
+        }
+        return self.fc.update_checkpoints(
+            self._cp(store.justified_checkpoint),
+            self._cp(store.finalized_checkpoint), balances)
+
+    def add_block(self, parent_root, slot, justified_cp=None,
+                  finalized_cp=None):
+        """Insert a crafted block into both sides; the crafted post-state
+        carries the leaf checkpoints the spec's viability filter reads."""
+        spec = self.spec
+        block = spec.BeaconBlock(
+            slot=slot,
+            parent_root=parent_root,
+            state_root=self.rng.getrandbits(256).to_bytes(32, "little"),
+        )
+        root = spec.hash_tree_root(block)
+        state = self.anchor_state.copy()
+        if justified_cp is not None:
+            state.current_justified_checkpoint = justified_cp
+        if finalized_cp is not None:
+            state.finalized_checkpoint = finalized_cp
+        self.store.blocks[root] = block
+        self.store.block_states[root] = state
+        self.fc.on_block(bytes(root), bytes(parent_root), int(slot),
+                         self._cp(state.current_justified_checkpoint),
+                         self._cp(state.finalized_checkpoint))
+        self.roots.append(root)
+        return root
+
+    def vote(self, validator, root, epoch):
+        """The latest-message rule, applied to both tables."""
+        spec, store = self.spec, self.store
+        existing = store.latest_messages.get(spec.ValidatorIndex(validator))
+        if existing is None or epoch > existing.epoch:
+            store.latest_messages[spec.ValidatorIndex(validator)] = \
+                spec.LatestMessage(epoch=spec.Epoch(epoch),
+                                   root=spec.Root(root))
+        self.fc.on_latest_message(int(validator), bytes(root), int(epoch))
+
+    def move_justified(self, epoch, root, balance_shuffle=False):
+        """A justified-checkpoint move, with an optionally perturbed
+        balance set in the new checkpoint state (exercises the proto
+        side's per-vote balance re-basing)."""
+        spec = self.spec
+        cp = spec.Checkpoint(epoch=epoch, root=root)
+        state = self.anchor_state.copy()
+        if balance_shuffle:
+            for i in range(0, len(state.validators), 3):
+                state.validators[i].effective_balance = \
+                    spec.EFFECTIVE_BALANCE_INCREMENT * (1 + i % 7)
+            # a couple of validators drop out of the active set entirely
+            state.validators[1].exit_epoch = spec.Epoch(0)
+            state.validators[5].exit_epoch = spec.Epoch(0)
+        self.store.checkpoint_states[cp] = state
+        self.store.justified_checkpoint = cp
+        return self.refresh()
+
+    def move_finalized(self, epoch, root):
+        self.store.finalized_checkpoint = self.spec.Checkpoint(
+            epoch=epoch, root=root)
+        return self.refresh()
+
+    def check(self):
+        self.fc.apply()
+        proto = self.fc.head()
+        oracle = bytes(self.spec.get_head(self.store))
+        assert proto == oracle, (
+            f"head diverged: proto={proto.hex()[:16]} "
+            f"oracle={oracle.hex()[:16]} over {len(self.roots)} blocks"
+        )
+        return proto
+
+
+def _grow_tree(m: Mirror, rng, blocks, max_slot, spine, agree=0.6):
+    """Random fork tree: every new block parents on any earlier-slot
+    block, so sibling races and skip-slots appear naturally. A fraction
+    of the crafted leaf states carry checkpoints AGREEING with the later
+    justified/finalized moves to ``spine`` — so post-move filtering stays
+    a weight race over a nontrivial viable subtree, never a collapse."""
+    cp1 = m.spec.Checkpoint(epoch=1, root=spine)
+    by_slot = {0: [m.anchor_root], 1: [spine]}
+    for _ in range(blocks):
+        slot = rng.randint(1, max_slot)
+        earlier = [s for s in by_slot if s < slot]
+        parent = rng.choice(by_slot[rng.choice(earlier)])
+        root = m.add_block(
+            parent, slot,
+            justified_cp=cp1 if rng.random() < agree else None,
+            finalized_cp=cp1 if rng.random() < agree else None,
+        )
+        by_slot.setdefault(slot, []).append(root)
+
+
+def _run_differential(spec, genesis_state, seed, blocks, vote_events,
+                      check_every=1):
+    """The randomized gate: grow, vote in batches, move checkpoints,
+    finalize + prune — oracle-equal heads after every batch."""
+    rng = random.Random(seed)
+    m = Mirror(spec, genesis_state, rng)
+    n_validators = len(genesis_state.validators)
+    # the spine block is the future justified/finalized checkpoint root
+    spine = m.add_block(m.anchor_root, 1)
+    _grow_tree(m, rng, blocks, max_slot=24, spine=spine)
+    m.check()
+
+    batch, applied = [], 0
+    checks = 0
+    for e in range(vote_events):
+        batch.append((rng.randrange(n_validators), rng.choice(m.roots),
+                      rng.randint(0, 4)))
+        if len(batch) >= 8:
+            for v, r, ep in batch:
+                m.vote(v, r, ep)
+            applied += len(batch)
+            batch = []
+            checks += 1
+            if checks % check_every == 0:
+                m.check()
+        if e == vote_events // 3:
+            # justified moves to the spine at epoch 1, with a changed
+            # balance set: weights must re-base exactly, and the agreeing
+            # leaf fraction keeps the filtered tree nontrivial
+            m.move_justified(1, spine, balance_shuffle=True)
+            m.check()
+        if e == (2 * vote_events) // 3:
+            # finalize the spine: the proto array prunes everything not
+            # descending from it; the spec store keeps all blocks — the
+            # heads must still agree
+            before = m.fc.block_count
+            m.move_finalized(1, spine)
+            pruned = before - m.fc.block_count
+            assert pruned > 0
+            m.check()
+    for v, r, ep in batch:
+        m.vote(v, r, ep)
+    m.check()
+    assert applied > 0
+
+
+# -- tier-1: small randomized trees ------------------------------------------
+
+
+def test_differential_small_trees(spec, genesis_state):
+    for seed in (1, 2, 3, 4):
+        _run_differential(spec, genesis_state, seed, blocks=20,
+                          vote_events=48)
+
+
+def test_differential_bushy_tie_breaks(spec, genesis_state):
+    # zero-weight sibling forests everywhere: the lexicographic tie-break
+    # is the only signal, and it must match the spec's max(weight, root)
+    rng = random.Random(99)
+    m = Mirror(spec, genesis_state, rng)
+    for slot in (1, 2, 3):
+        for _ in range(4):
+            m.add_block(m.anchor_root, slot)
+        m.check()
+    # one vote flips the whole forest to the voted branch
+    m.vote(0, m.roots[5], 1)
+    m.check()
+
+
+def test_latest_message_rule(spec, genesis_state):
+    # a same-epoch vote must NOT displace; a newer-epoch vote must
+    rng = random.Random(5)
+    m = Mirror(spec, genesis_state, rng)
+    a = m.add_block(m.anchor_root, 1)
+    b = m.add_block(m.anchor_root, 1)
+    m.vote(0, a, 1)
+    assert m.check() == bytes(a)
+    m.vote(0, b, 1)  # same epoch: must NOT displace
+    assert m.check() == bytes(a)
+    m.vote(0, b, 2)  # newer epoch: must move
+    assert m.check() == bytes(b)
+
+
+def test_viability_filters_nonmatching_leaves(spec, genesis_state):
+    """A branch whose leaf state disagrees with the store's justified
+    checkpoint must lose to a viable branch regardless of weight — and
+    when NO leaf is viable, the head collapses to the justified root."""
+    rng = random.Random(6)
+    m = Mirror(spec, genesis_state, rng)
+    good_cp = spec.Checkpoint(epoch=1, root=m.anchor_root)
+    stale_cp = spec.Checkpoint(epoch=1,
+                               root=spec.Root(b"\x42" * 32))
+    viable = m.add_block(m.anchor_root, 1, justified_cp=good_cp)
+    heavy = m.add_block(m.anchor_root, 1, justified_cp=stale_cp)
+    for v in range(8):
+        m.vote(v, heavy, 1)
+    m.move_justified(1, m.anchor_root)
+    head = m.check()
+    assert head == viable  # the heavy branch is filtered out
+    # drop the last viable leaf's agreement too: justified root wins
+    m.move_justified(2, m.anchor_root)
+    head = m.check()
+    assert head == bytes(m.anchor_root)
+
+
+def test_pruning_keeps_heads_and_shrinks(spec, genesis_state):
+    rng = random.Random(7)
+    m = Mirror(spec, genesis_state, rng)
+    keep_root = m.add_block(m.anchor_root, 1)
+    cp1 = spec.Checkpoint(epoch=1, root=keep_root)
+    trunk = keep_root
+    side_roots = []
+    for slot in range(2, 8):
+        trunk = m.add_block(trunk, slot, justified_cp=cp1, finalized_cp=cp1)
+        side_roots.append(m.add_block(m.anchor_root, slot))  # pruned later
+    m.check()
+    before = m.fc.block_count
+    m.move_finalized(1, keep_root)
+    m.move_justified(1, keep_root)
+    assert m.fc.block_count < before
+    head = m.check()
+    assert head == bytes(trunk)  # the agreeing trunk leaf wins post-prune
+    # votes referencing pruned side branches must be inert, not fatal
+    m.vote(0, side_roots[0], 3)
+    m.check()
+
+
+# -- proto-array unit behaviors ----------------------------------------------
+
+
+def test_insert_contract():
+    arr = ProtoArray()
+    arr.insert(b"a" * 32, None, 0, (0, b""), (0, b""))
+    arr.insert(b"b" * 32, b"a" * 32, 1, (0, b""), (0, b""))
+    arr.insert(b"b" * 32, b"a" * 32, 1, (0, b""), (0, b""))  # dup: no-op
+    assert len(arr) == 2
+    with pytest.raises(KeyError):
+        arr.insert(b"c" * 32, b"zz" * 16, 2, (0, b""), (0, b""))
+    arr.add_delta(b"missing" * 4 + b"e" * 4, 100)  # swallowed
+    arr.apply((0, b""), (0, b""))
+    assert arr.head(b"a" * 32) == b"b" * 32
+
+
+def test_reorg_depth_walk():
+    arr = ProtoArray()
+    arr.insert(b"a" * 32, None, 0, (0, b""), (0, b""))
+    arr.insert(b"b" * 32, b"a" * 32, 1, (0, b""), (0, b""))
+    arr.insert(b"c" * 32, b"b" * 32, 2, (0, b""), (0, b""))
+    arr.insert(b"d" * 32, b"a" * 32, 3, (0, b""), (0, b""))
+    # c -> d forks at a: rolls back c's 2 slots
+    assert arr.reorg_depth(b"c" * 32, b"d" * 32) == 2
+    # extension is not a reorg
+    assert arr.reorg_depth(b"b" * 32, b"c" * 32) == 0
+    assert arr.reorg_depth(b"x" * 32, b"c" * 32) == 0  # unknown: 0
+
+
+def test_prune_rebuild_indices():
+    arr = ProtoArray()
+    arr.insert(b"a" * 32, None, 0, (0, b""), (0, b""))
+    arr.insert(b"b" * 32, b"a" * 32, 1, (0, b""), (0, b""))
+    arr.insert(b"s" * 32, b"a" * 32, 1, (0, b""), (0, b""))
+    arr.insert(b"c" * 32, b"b" * 32, 2, (0, b""), (0, b""))
+    dropped = arr.prune(b"b" * 32)
+    assert dropped == 2 and len(arr) == 2
+    assert b"s" * 32 not in arr and b"a" * 32 not in arr
+    arr.apply((0, b""), (0, b""))
+    assert arr.head(b"b" * 32) == b"c" * 32
+
+
+# -- slow: wide randomized stress --------------------------------------------
+
+
+@pytest.mark.slow
+def test_differential_wide_trees_slow(spec, genesis_state):
+    """64+-block trees, >1k latest-message updates, checkpoint moves and
+    pruning — the full-width differential gate."""
+    for seed in (11, 12, 13):
+        _run_differential(spec, genesis_state, seed, blocks=96,
+                          vote_events=400, check_every=1)
+
+
+@pytest.mark.slow
+def test_differential_deep_churn_slow(spec, genesis_state):
+    # a 160-block tree under sustained vote churn across 5 epochs
+    _run_differential(spec, genesis_state, 21, blocks=160, vote_events=640)

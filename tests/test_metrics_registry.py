@@ -23,6 +23,9 @@ _CALL_RE = re.compile(
     r"profiling\s*\.\s*(?:set_gauge|record_latency|record)\(\s*[\"']([^\"']+)[\"']"
 )
 _LABEL_CONST_RE = re.compile(r"^[A-Z_]*LABEL\s*=\s*\"([^\"]+)\"", re.M)
+# whole-family declarations (chain/metrics.py GAUGE_LABELS): a tuple of
+# label strings exported in a loop — scan every quoted member
+_LABEL_TUPLE_RE = re.compile(r"^[A-Z_]*LABELS\s*=\s*\(([^)]*)\)", re.M | re.S)
 _ENV_RE = re.compile(r"CONSENSUS_SPECS_TPU_[A-Z0-9_]+")
 
 
@@ -44,6 +47,9 @@ def _emitted_labels():
             labels.setdefault(m.group(1), path)
         for m in _LABEL_CONST_RE.finditer(text):
             labels.setdefault(m.group(1), path)
+        for m in _LABEL_TUPLE_RE.finditer(text):
+            for member in re.findall(r"\"([^\"]+)\"", m.group(1)):
+                labels.setdefault(member, path)
     return labels
 
 
@@ -64,8 +70,24 @@ def test_emitted_labels_were_actually_found():
     # have to show up, else a refactor broke the regexes, not the metrics
     found = _emitted_labels()
     for expected in ("serve.queue_depth", "serve.submit_to_result",
-                     "bls.rlc_combines", "bls.vm_cache_hits"):
+                     "bls.rlc_combines", "bls.vm_cache_hits",
+                     "chain.apply_batch", "chain.head_changes",
+                     "chain.reorgs", "chain.dropped_attestations"):
         assert expected in found, f"label scan lost {expected}"
+
+
+def test_chain_gauge_family_is_complete():
+    # the chain plane exports its whole gauge family from one tuple; every
+    # member must be a registered gauge and every registered chain gauge
+    # must be in the tuple (else export_gauges silently skips it)
+    from consensus_specs_tpu.chain import metrics as chain_metrics
+
+    declared = set(chain_metrics.GAUGE_LABELS)
+    registered = {n for n in registry.GAUGES if n.startswith("chain.")}
+    assert declared == registered, (
+        f"chain gauge drift: declared-not-registered={declared - registered}, "
+        f"registered-not-declared={registered - declared}"
+    )
 
 
 def test_registry_names_are_documented():

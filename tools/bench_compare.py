@@ -56,6 +56,11 @@ def _shape_key(parsed):
     n, k = parsed.get("n"), parsed.get("k")
     if mode == "committee" and n and k:
         return f"committee[{n}x{k}]"
+    if mode == "head" and parsed.get("blocks"):
+        # chain-plane lines key by tree size (bench.py --mode head emits
+        # the same `head[<blocks>]` keys in per_mode_best): a 64-block
+        # tree's heads/sec must never score against a 1024-block tree's
+        return f"head[{parsed['blocks']}]"
     return str(mode)
 
 

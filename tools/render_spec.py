@@ -26,6 +26,7 @@ _TITLES = {
     "fork_choice": "Fork Choice",
     "validator": "Honest Validator",
     "p2p": "Networking (computable parts)",
+    "client_settings": "Client Settings (TTD override)",
     "weak_subjectivity": "Weak Subjectivity",
     "fork": "Fork Transition",
     "bls": "BLS Extensions",
