@@ -16,7 +16,7 @@ HEAVY_GENERATORS = operations sanity epoch_processing rewards finality forks tra
                    random fork_choice ssz_static genesis
 CHEAP_GENERATORS = shuffling bls ssz_generic merkle
 
-.PHONY: test citest test_tpu_backend lint generate_tests \
+.PHONY: test citest test_tpu_backend lint vmlint vm-cache-prune generate_tests \
         detect_generator_incomplete check_vectors bench serve-bench codec-bench multichip \
         clean_vectors generate_random_tests bench-compare check serve-trace head-bench docs
 
@@ -41,6 +41,24 @@ test_tpu_backend:
 lint:
 	python -m compileall -q consensus_specs_tpu tests bench.py __graft_entry__.py
 	JAX_PLATFORMS=cpu python tools/speclint.py
+
+# VM static-analysis gate (tools/vmlint.py over ops/vm_analysis.py): every
+# registered field-ALU program gets its magnitude bounds independently
+# re-derived and cross-checked against the assembler (carry-safety of the
+# 15-limb lanes), its register pressure and live-range-outlier hazards
+# checked, and its critical-path/width/cost profile diffed against the
+# committed VMLINT_BASELINE.json — a pressure or depth regression fails.
+# Re-pin after a conscious program change: python tools/vmlint.py --update-baseline
+vmlint:
+	JAX_PLATFORMS=cpu python tools/vmlint.py
+
+# bound .vm_cache/ growth: every vmlib/vm/fq edit re-keys all cached
+# programs, so stale multi-MB pickles accumulate — evict entries idle
+# longer than VM_CACHE_MAX_AGE_DAYS (default 30) and oldest-first past
+# VM_CACHE_MAX_BYTES (default 2 GiB)
+vm-cache-prune:
+	python -c "from consensus_specs_tpu.ops.bls_backend import prune_vm_cache; \
+	import json; print(json.dumps(prune_vm_cache()))"
 
 # emit every cross-client vector suite (reference `make generate_tests`)
 generate_tests:
@@ -91,7 +109,7 @@ bench-compare:
 	python tools/bench_compare.py
 
 # the static + perf check flow CI runs alongside the test matrix
-check: lint bench-compare
+check: lint vmlint bench-compare
 
 # streaming serve plane (consensus_specs_tpu/serve/): short CPU-sized
 # synthetic gossip load — Poisson arrivals, duplicate-heavy traffic, one

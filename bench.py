@@ -227,6 +227,11 @@ def run_workload(emit_partial=None, override=None, child_quick=False) -> dict:
     final = result(n * k / best)
     if profiling.enabled():  # dynamic check: env flips after import count
         final["profile"] = profiling.summary()
+        # per-program provenance: steps/regs/assembly source for every VM
+        # program this run resolved — plus the vmlint analysis stats
+        # (max_live, critical path, classification) when a vm_analysis
+        # pass ran in this process (obs/programs.note_analysis)
+        final["programs"] = obs_programs.registry_snapshot()["programs"]
     return final
 
 

@@ -24,13 +24,30 @@ def note_assembly(key: str, *, n_steps: int, n_regs: int, seconds: float,
     ``seconds`` is whichever path was paid)."""
     with _lock:
         CACHE_STATS["disk_hits" if disk_cache_hit else "disk_misses"] += 1
-        PROGRAMS[key] = {
+        # merge, don't replace: an analyze-then-execute ordering must keep
+        # the "analysis" sub-dict note_analysis attached to this key
+        PROGRAMS.setdefault(key, {}).update({
             "steps": int(n_steps),
             "regs": int(n_regs),
             "assembly_s": round(float(seconds), 4),
             "vm_cache": "hit" if disk_cache_hit else "miss",
-        }
+        })
     export_gauges()
+
+
+def note_analysis(key: str, **stats) -> None:
+    """Merge vmlint static-analysis stats (max_live, critical_path,
+    classification, predicted runtime, error/hazard flags — see
+    ops/vm_analysis.export_to_obs) onto a program's registry entry, so the
+    Chrome trace export's ``programRegistry`` carries the analysis next to
+    the measured assembly numbers. Creates the entry when the program was
+    analyzed but never resolved for execution in this process."""
+    with _lock:
+        entry = PROGRAMS.setdefault(key, {})
+        entry["analysis"] = {
+            k: (round(float(v), 4) if isinstance(v, float) else v)
+            for k, v in stats.items()
+        }
 
 
 def export_gauges() -> None:
@@ -51,7 +68,11 @@ def export_gauges() -> None:
 def registry_snapshot() -> Dict:
     with _lock:
         return {
-            "programs": {k: dict(v) for k, v in sorted(PROGRAMS.items())},
+            "programs": {
+                k: {kk: (dict(vv) if isinstance(vv, dict) else vv)
+                    for kk, vv in v.items()}
+                for k, v in sorted(PROGRAMS.items())
+            },
             "vm_cache": dict(CACHE_STATS),
         }
 

@@ -57,6 +57,17 @@ GAUGES: Dict[str, str] = {
     "chain.dropped_attestations": "attestations rejected: bad signature, "
                                   "non-viable vote, or retries exhausted",
     "chain.deferred_pending": "deferral buffer depth right now",
+    "vm.analysis_programs": "VM programs analyzed by the last vmlint run "
+                            "in this process",
+    "vm.analysis_errors": "bound-soundness errors vmlint found (nonzero "
+                          "means the assembler's carry-safety tracker and "
+                          "the independent re-derivation disagree)",
+    "vm.analysis_warnings": "vmlint waste findings: redundant compress "
+                            "multiplies, dead values, unused inputs",
+    "vm.analysis_hazards": "programs tripping the live-range-outlier "
+                           "register-pressure hazard rule",
+    "vm.analysis_max_live": "max register pressure (live values at one "
+                            "step) across the analyzed programs",
 }
 
 STATS: Dict[str, str] = {

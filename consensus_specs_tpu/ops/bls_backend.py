@@ -176,26 +176,18 @@ def _program(kind: str, k: int = 0, fold: int = None) -> Tuple[vm.Program, int]:
     try:
         with open(path, "rb") as fh:
             loaded = pickle.load(fh)
+        try:
+            os.utime(path)  # mark touched: vm-cache-prune evicts by idle age
+        except OSError:
+            pass
         _note_program(kind, k, fold, loaded, time.perf_counter() - t0, True)
         return loaded, fold
     except Exception:
         pass  # absent/stale cache: assemble below
-    if kind == "miller_product":
-        prog = vmlib.build_miller_product(k, fold)
-    elif kind == "aggregate_verify":
-        prog = vmlib.build_aggregate_verify_miller(k, fold)
-    elif kind == "hard_part":
-        prog = vmlib.build_hard_part(fold)
-    elif kind == "rlc_combine":
-        prog = vmlib.build_rlc_combine(k, fold)
-    elif kind == "g1_subgroup":
-        prog = vmlib.build_g1_subgroup_check(fold)
-    elif kind == "g2_subgroup":
-        prog = vmlib.build_g2_subgroup_check(fold)
-    elif kind == "h2g_finish":
-        prog = vmlib.build_h2g_finish(fold)
-    else:
+    builder = vmlib.BUILDERS.get(kind)
+    if builder is None:
         raise ValueError(kind)
+    prog = builder(k, fold)
     assembled = prog.assemble(
         w_mul=W_MUL,
         w_lin=W_LIN,
@@ -211,6 +203,68 @@ def _program(kind: str, k: int = 0, fold: int = None) -> Tuple[vm.Program, int]:
     except Exception:
         pass  # cache write is an optimization only
     return assembled, fold
+
+
+def prune_vm_cache(max_age_days: float = None, max_bytes: int = None,
+                   cache_dir: str = None) -> dict:
+    """Bound ``.vm_cache/`` growth (`make vm-cache-prune`): every edit of
+    vmlib/vm/fq re-keys EVERY cached program (the source-hash fingerprint),
+    so stale multi-MB pickles accumulate forever without eviction. Two
+    rules, both idle-age-ordered (``_program`` touches entries on every
+    disk hit, so mtime == last use):
+
+    - entries idle longer than ``max_age_days`` are evicted
+      (env VM_CACHE_MAX_AGE_DAYS, default 30; <= 0 disables the age rule);
+    - if the cache still exceeds ``max_bytes`` the oldest entries go until
+      it fits (env VM_CACHE_MAX_BYTES, default 2 GiB; <= 0 disables).
+
+    Returns {"kept", "evicted", "kept_bytes", "evicted_bytes"}."""
+    if max_age_days is None:
+        max_age_days = float(os.environ.get("VM_CACHE_MAX_AGE_DAYS", "30"))
+    if max_bytes is None:
+        max_bytes = int(os.environ.get("VM_CACHE_MAX_BYTES",
+                                       str(2 * 1024 ** 3)))
+    if cache_dir is None:
+        cache_dir = _vm_cache_dir()
+    now = time.time()
+    entries = []  # (mtime, size, path)
+    for name in os.listdir(cache_dir):
+        # cache entries plus crash-orphaned "<name>.pkl.<pid>.tmp" files
+        # from an interrupted _program write; foreign files stay untouched
+        if not (name.endswith(".pkl")
+                or (".pkl." in name and name.endswith(".tmp"))):
+            continue
+        path = os.path.join(cache_dir, name)
+        try:
+            st = os.stat(path)
+        except OSError:
+            continue
+        entries.append((st.st_mtime, st.st_size, path))
+    entries.sort()  # oldest (least recently used) first
+    evict = []
+    if max_age_days > 0:
+        cutoff = now - max_age_days * 86400.0
+        while entries and entries[0][0] < cutoff:
+            evict.append(entries.pop(0))
+    if max_bytes > 0:
+        total = sum(size for _, size, _ in entries)
+        while entries and total > max_bytes:
+            oldest = entries.pop(0)
+            total -= oldest[1]
+            evict.append(oldest)
+    evicted_bytes = 0
+    for _, size, path in evict:
+        try:
+            os.remove(path)
+            evicted_bytes += size
+        except OSError:
+            pass
+    return {
+        "kept": len(entries),
+        "evicted": len(evict),
+        "kept_bytes": sum(size for _, size, _ in entries),
+        "evicted_bytes": evicted_bytes,
+    }
 
 
 def _note_program(kind: str, k: int, fold: int, assembled, seconds: float,

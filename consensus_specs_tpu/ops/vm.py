@@ -191,6 +191,18 @@ class Prog:
         self.outputs.append(v.idx)
         self.output_names.append(name)
 
+    # -- static analysis ----------------------------------------------------
+
+    def analyze(self, name: str = "<prog>", **assemble_kwargs):
+        """vmlint entry point over the IR: assemble with the given shape
+        (schedules + allocates, annotating every op with step/reg/last-use)
+        and run the full vm_analysis pass — independent bound re-derivation,
+        liveness/register-pressure, critical-path/cost reports. Returns the
+        report dict (see ops/vm_analysis.py)."""
+        from . import vm_analysis
+
+        return vm_analysis.analyze_prog(self, name=name, **assemble_kwargs)
+
     # -- scheduling + register allocation ----------------------------------
 
     def assemble(
@@ -206,6 +218,15 @@ class Prog:
         ops = self.ops
         n = len(ops)
         is_alu = [op.kind in (_MUL, _ADD, _SUB) for op in ops]
+
+        # re-assembly must start clean: step/last-use/reg are schedule
+        # outputs, and a previous assemble at a different shape would
+        # otherwise bleed through the max() accumulation below (stale live
+        # ranges -> corrupted liveness and allocation)
+        for op in ops:
+            op.step = -1
+            op.last_use_step = -1
+            op.reg = -1
 
         # 1) list-schedule ALU ops into steps
         unit_of = [0 if op.kind == _MUL else 1 for op in ops]
@@ -277,6 +298,7 @@ class Prog:
             for r in expiry.get(t, ()):
                 free.append(r)
 
+        sched_steps = n_steps  # pre-padding schedule length
         n_steps = -(-n_steps // pad_steps_to) * pad_steps_to
         n_regs = next_reg
         # trash registers for idle lanes
@@ -320,6 +342,8 @@ class Prog:
         input_regs = [ops[i].reg for i in self.inputs]
         output_regs = [ops[i].reg for i in self.outputs]
 
+        n_mul = sum(1 for i, op in enumerate(ops) if is_alu[i] and unit_of[i] == 0)
+        n_lin = sum(1 for i, op in enumerate(ops) if is_alu[i] and unit_of[i] == 1)
         return Program(
             n_regs=n_regs,
             instr=(msa, msb, msd, lsa, lsb, lsub, lsd),
@@ -329,6 +353,19 @@ class Prog:
             output_names=list(self.output_names),
             const_regs=const_payload,
             n_steps=n_steps,
+            # schedule metadata for vm_analysis.program_stats — lets the
+            # analyzer report on cache-loaded assembled programs whose IR
+            # is not in memory (old .vm_cache pickles lack it: meta=None)
+            meta={
+                "sched_steps": sched_steps,
+                "n_mul": n_mul,
+                "n_lin": n_lin,
+                "alloc_regs": next_reg,
+                "trash_mul": trash_mul,
+                "trash_lin": trash_lin,
+                "w_mul": w_mul,
+                "w_lin": w_lin,
+            },
         )
 
 
@@ -344,6 +381,7 @@ class Program:
     output_names: List[str]
     const_regs: Dict[int, int]  # reg -> plain int value
     n_steps: int
+    meta: Optional[Dict] = None  # assemble-time schedule stats (vm_analysis)
 
     def init_regs(self, batch_shape: Tuple[int, ...]) -> np.ndarray:
         """Fresh register file with constants loaded (host-side numpy)."""

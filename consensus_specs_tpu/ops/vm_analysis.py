@@ -1,0 +1,632 @@
+"""vmlint core: static analysis over field-ALU VM programs (ops/vm.py IR).
+
+The VM is the cryptographic hot path and its only inline safety net is the
+assembler's bound tracker (`Prog._push` asserting < 2^420). This module is
+the independent second opinion plus the planning artifacts the optimization
+roadmap needs, in three passes over a built `Prog` and its list schedule:
+
+1. **Bound soundness** (`check_bounds`): a forward interval analysis over
+   the op DAG that re-derives every value-magnitude bound from scratch —
+   the Montgomery-mul / add / borrowless-sub transfer functions are written
+   out HERE, not called through `Prog` — and cross-checks the assembler's
+   recorded bound op-by-op. Any mismatch, any derived bound at or past the
+   15-limb capacity, any borrowless-subtract precondition violation
+   (subtrahend > MP, minuend + MP >= capacity) and any input declared
+   tighter than a canonical Montgomery residue is an ERROR. The same pass
+   flags waste: `compress` multiplies that achieve no magnitude reduction,
+   ALU values that never reach an `out()` (dead lanes), and unused inputs.
+
+2. **Liveness / register pressure** (`check_pressure`): per-step live sets
+   over the assembled schedule — max pressure, mean, a compact histogram,
+   the allocator's achieved register count — and the **live-range outlier**
+   rule that statically detects the PR 3 scheduler hazard: input-ready ops
+   placed at step ~0 whose values sit live for thousands of steps (the
+   select-then-multiply RLC ladder cost a measured 10x register-file
+   blowup). A program is hazard-flagged when the number of long-lived ALU
+   values exceeds a budget scaled to its input count — loop-invariant
+   operands (e.g. the RLC ladder's f-1 coefficients) legitimately live
+   long, so the rule keys on the *count*, not the existence.
+
+3. **Critical path / cost** (`check_cost`): longest dependency chain,
+   per-level width profile, mul/add unit mix, and a predicted CPU runtime
+   from the measured cost model (~280 us/step at a ~600-register file,
+   scaling linearly with register-file size — gather/scatter traffic
+   dominates the step cost). Each program is classified depth-bound /
+   width-bound / balanced — the artifact ROADMAP item 5's width-for-depth
+   rewrites of the final exponentiation start from.
+
+`analyze_prog` runs all three and returns one JSON-able report;
+`registry_programs` enumerates the production program registry (shared
+with ops/bls_backend via vmlib.BUILDERS) and `run_registry` analyzes it,
+exporting summary gauges + per-program stats through the obs/ planes.
+`gate` compares reports against the committed VMLINT_BASELINE.json.
+"""
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+from . import fq
+
+# op kinds, mirroring ops/vm.py (inputs -1, consts -2, ALU 0/1/2)
+_MUL, _ADD, _SUB = 0, 1, 2
+
+# 15 x 28-bit limb value capacity, re-derived from the limb layout rather
+# than imported from vm.py — the whole point is an independent check
+B_CAP = 1 << (fq.LIMB_BITS * fq.NUM_LIMBS)
+
+# measured cost model (2-core CPU container, jax 0.4.37): warm execute is
+# ~280 us per scan step at a ~600-register file, scaling ~linearly with
+# register-file size (per-step gather/scatter traffic dominates)
+COST_US_PER_STEP = 280.0
+COST_MODEL_REGS = 600.0
+
+# live-range outlier rule: an ALU value is "long-lived" when its live range
+# exceeds max(LONG_RANGE_MIN_STEPS, LONG_RANGE_FRAC x scheduled steps). The
+# program is hazard-flagged when long-lived values OCCUPY the register file:
+# their step-occupancy integral (sum of live-range lengths) exceeds
+# HAZARD_OCCUPANCY_FRAC of the total occupancy integral (= sum of the
+# per-step pressure), with an absolute count floor so a handful of
+# legitimately long-lived values never trips it. Measured on the registry:
+# healthy programs keep the long-lived share at ~15-30% (loop-invariant
+# operands like the RLC ladder's f-1 coefficients and their CSE'd Karatsuba
+# half-sums legitimately live the whole program), while the PR 3
+# select-then-multiply pattern — input-ready ops scheduled at step ~0,
+# consumed thousands of steps later — puts it at ~70-90%.
+LONG_RANGE_MIN_STEPS = 256
+LONG_RANGE_FRAC = 0.5
+HAZARD_MIN_BUDGET = 64
+HAZARD_OCCUPANCY_FRAC = 0.5
+
+
+# ---------------------------------------------------------------------------
+# pass 1: bound soundness
+# ---------------------------------------------------------------------------
+
+
+def _derive_bound(kind: int, ba: int, bb: int) -> int:
+    """Independent transfer functions for the three ALU ops.
+
+    MUL is Montgomery: out = (a*b + m*p) / R with m < R, so
+    out < a*b/R + p + 1. ADD is exact. SUB is the borrowless form
+    out = a + (MP + 1) + (MASK-form of -b), whose value is a - b + (MP + 1)
+    <= a + MP + 1 - (b's minimum 0) — bounded by a + MP."""
+    if kind == _MUL:
+        return (ba * bb) // fq.R_MONT + fq.P + 1
+    if kind == _ADD:
+        return ba + bb
+    if kind == _SUB:
+        return ba + fq.MP
+    raise ValueError(kind)
+
+
+def check_bounds(prog) -> Dict:
+    """Forward interval analysis + assembler cross-check + waste rules."""
+    ops = prog.ops
+    derived: List[Optional[int]] = [None] * len(ops)
+    errors: List[Dict] = []
+    warnings: List[Dict] = []
+    checked = 0
+    max_bound = 0
+    compress_ops = 0
+    redundant_compress: List[int] = []
+
+    def err(idx, rule, detail):
+        errors.append({"severity": "error", "rule": rule, "op": idx,
+                       "detail": detail})
+
+    # const-1 op indices: a mul against one of these is a compress
+    one_idxs = {idx for value, idx in prog.consts.items() if value == 1}
+
+    for i, op in enumerate(ops):
+        if op.kind == -1:  # input: the declared bound is the axiom, but a
+            # canonical Montgomery residue can be any value < p, so a
+            # declaration tighter than p is unsound for every real feed
+            if op.bound < fq.P:
+                err(i, "input-bound-unsound",
+                    f"input declared bound 2^{op.bound.bit_length() - 1} "
+                    "< p — canonical Montgomery residues reach p-1")
+            if op.bound >= B_CAP:
+                err(i, "input-bound-overflow",
+                    "declared input bound exceeds the 15-limb capacity")
+            derived[i] = op.bound
+            continue
+        if op.kind == -2:  # const: encoded to Montgomery form mod p
+            derived[i] = fq.P
+            if op.bound != fq.P:
+                err(i, "const-bound-mismatch",
+                    f"const tracked at 2^{op.bound.bit_length() - 1}, "
+                    "expected p")
+            continue
+        ba, bb = derived[op.a], derived[op.b]
+        if ba is None or bb is None:
+            err(i, "dataflow-order",
+                "operand defined after its consumer — IR not topological")
+            derived[i] = op.bound
+            continue
+        if op.kind == _SUB:
+            # borrowless-subtract preconditions: MP - b must not borrow,
+            # and the shifted result must fit the limb capacity
+            if bb > fq.MP:
+                err(i, "sub-subtrahend-overflow",
+                    f"subtrahend bound 2^{bb.bit_length() - 1} exceeds the "
+                    "MP shift — borrowless subtract would underflow")
+            if ba + fq.MP >= B_CAP:
+                err(i, "sub-minuend-overflow",
+                    "minuend + MP exceeds the 15-limb capacity")
+        d = _derive_bound(op.kind, ba, bb)
+        derived[i] = d
+        checked += 1
+        max_bound = max(max_bound, d)
+        if d >= B_CAP:
+            err(i, "bound-overflow",
+                f"derived bound 2^{d.bit_length() - 1} >= capacity 2^420 — "
+                "limb carries can overflow the 15-limb lane")
+        if d != op.bound:
+            err(i, "bound-mismatch",
+                f"assembler tracked 2^{op.bound.bit_length() - 1}, "
+                f"analysis derives 2^{d.bit_length() - 1} "
+                f"({'assembler UNDER-estimates (unsound)' if op.bound < d else 'assembler over-estimates (formula drift)'})")
+        if op.kind == _MUL and (op.a in one_idxs or op.b in one_idxs):
+            compress_ops += 1
+            src = op.b if op.a in one_idxs else op.a
+            in_bound = derived[src]
+            if in_bound is not None and d >= in_bound:
+                # the multiply achieved no magnitude reduction: a wasted
+                # mul-lane slot (compress pays off only past ~2^383)
+                redundant_compress.append(i)
+
+    # dead-value sweep: backward reachability from the outputs
+    reachable = [False] * len(ops)
+    stack = list(prog.outputs)
+    for idx in stack:
+        reachable[idx] = True
+    while stack:
+        i = stack.pop()
+        op = ops[i]
+        if op.kind in (_MUL, _ADD, _SUB):
+            for src in (op.a, op.b):
+                if not reachable[src]:
+                    reachable[src] = True
+                    stack.append(src)
+    dead_ops = [
+        i for i, op in enumerate(ops)
+        if op.kind in (_MUL, _ADD, _SUB) and not reachable[i]
+    ]
+    unused_inputs = [i for i in prog.inputs if not reachable[i]]
+    if dead_ops:
+        warnings.append({
+            "severity": "warn", "rule": "dead-values",
+            "detail": f"{len(dead_ops)} ALU ops never reach an out() — "
+                      "scheduled work feeding nothing",
+        })
+    if unused_inputs:
+        warnings.append({
+            "severity": "warn", "rule": "unused-inputs",
+            "detail": f"{len(unused_inputs)} inputs never reach an out()",
+        })
+    if redundant_compress:
+        warnings.append({
+            "severity": "warn", "rule": "redundant-compress",
+            "detail": f"{len(redundant_compress)} compress multiplies "
+                      "achieve no magnitude reduction (input bound already "
+                      "compressed-size) — wasted mul-lane slots",
+        })
+    return {
+        "checked": checked,
+        "max_bound_bits": max_bound.bit_length(),
+        "compress_ops": compress_ops,
+        "redundant_compress": len(redundant_compress),
+        "dead_ops": len(dead_ops),
+        "unused_inputs": len(unused_inputs),
+        "errors": errors,
+        "warnings": warnings,
+    }
+
+
+# ---------------------------------------------------------------------------
+# pass 2: liveness / register pressure (needs the assembled schedule)
+# ---------------------------------------------------------------------------
+
+
+def check_pressure(prog, assembled, keep_per_step: bool = False) -> Dict:
+    """Per-step live sets over the schedule `assemble()` annotated onto the
+    ops (step / last_use_step), plus the live-range-outlier hazard rule.
+    ``keep_per_step`` attaches the full per-step pressure curve (one int
+    per scheduled step) instead of only the 8-sample profile."""
+    ops = prog.ops
+    meta = assembled.meta or {}
+    sched_steps = meta.get("sched_steps")
+    if sched_steps is None:
+        sched_steps = max(
+            (op.step for op in ops if op.step >= 0), default=-1) + 1
+    # live interval per value: [start, end] inclusive, in schedule steps.
+    # inputs/consts are defined before step 0; outputs are read "after the
+    # end" (assemble marks them n_steps + 1) — clamp into the step range.
+    delta = [0] * (sched_steps + 2)
+    n_used_inputs = 0
+    long_threshold = max(LONG_RANGE_MIN_STEPS,
+                         int(LONG_RANGE_FRAC * sched_steps))
+    long_lived = 0
+    long_occupancy = 0  # step-occupancy integral of the long-lived values
+    ranges = []  # (range_len, idx) for the outlier report
+    for i, op in enumerate(ops):
+        alu = op.kind in (_MUL, _ADD, _SUB)
+        start = op.step if alu else 0
+        if start < 0:
+            continue  # unscheduled (shouldn't happen post-assemble)
+        end = op.last_use_step
+        if end < 0:
+            end = start  # dead value: freed right after definition
+        end = min(end, sched_steps)
+        delta[start] += 1
+        delta[end + 1] -= 1
+        if not alu and op.kind == -1 and op.last_use_step >= 0:
+            n_used_inputs += 1
+        if alu and (end - start) > long_threshold:
+            long_lived += 1
+            long_occupancy += end - start + 1
+            ranges.append((end - start, i))
+    pressure = []
+    cur = 0
+    for t in range(sched_steps):
+        cur += delta[t]
+        pressure.append(cur)
+    max_live = max(pressure, default=0)
+    mean_live = sum(pressure) / len(pressure) if pressure else 0.0
+    # compact histogram: live-set size sampled at 8 evenly spaced steps
+    profile = []
+    if pressure:
+        for q in range(8):
+            profile.append(pressure[(q * (len(pressure) - 1)) // 7 if len(pressure) > 1 else 0])
+    total_occupancy = sum(pressure)
+    occupancy_share = (
+        long_occupancy / total_occupancy if total_occupancy else 0.0)
+    hazard = (long_lived > HAZARD_MIN_BUDGET
+              and occupancy_share > HAZARD_OCCUPANCY_FRAC)
+    ranges.sort(reverse=True)
+    alloc_regs = meta.get("alloc_regs")
+    findings = []
+    if hazard:
+        findings.append({
+            "severity": "error", "rule": "live-range-outliers",
+            "detail": (
+                f"{long_lived} ALU values live > {long_threshold} steps, "
+                f"occupying {occupancy_share:.0%} of the register file's "
+                f"step-occupancy (healthy programs stay under "
+                f"{HAZARD_OCCUPANCY_FRAC:.0%}): input-ready ops scheduled "
+                "at step ~0 and consumed far later dominate the file; "
+                "chain them on the consumer (the PR 3 select-then-multiply "
+                "register blowup)"),
+        })
+    out = {
+        "sched_steps": sched_steps,
+        "max_live": max_live,
+        "mean_live": round(mean_live, 1),
+        "pressure_profile": profile,
+        "alloc_regs": alloc_regs,
+        "alloc_efficiency": (
+            round(max_live / alloc_regs, 3) if alloc_regs else None),
+        "long_range_threshold": long_threshold,
+        "long_lived": long_lived,
+        "used_inputs": n_used_inputs,
+        "long_occupancy_share": round(occupancy_share, 3),
+        "hazard": hazard,
+        "worst_ranges": [r for r, _ in ranges[:5]],
+        "findings": findings,
+    }
+    if keep_per_step:
+        out["per_step"] = pressure
+    return out
+
+
+# ---------------------------------------------------------------------------
+# pass 3: critical path / unit mix / cost model
+# ---------------------------------------------------------------------------
+
+
+def check_cost(prog, assembled, w_mul: int, w_lin: int) -> Dict:
+    """Longest dependency chain, per-level width profile, unit mix, and the
+    measured-cost-model runtime prediction + depth/width classification."""
+    ops = prog.ops
+    level = [0] * len(ops)
+    n_mul = n_add = n_sub = 0
+    critical = 0
+    for i, op in enumerate(ops):
+        if op.kind == _MUL:
+            n_mul += 1
+        elif op.kind == _ADD:
+            n_add += 1
+        elif op.kind == _SUB:
+            n_sub += 1
+        else:
+            continue
+        level[i] = 1 + max(level[op.a], level[op.b])
+        critical = max(critical, level[i])
+    n_lin = n_add + n_sub
+    work_steps = max(-(-n_mul // w_mul) if n_mul else 0,
+                     -(-n_lin // w_lin) if n_lin else 0)
+    meta = assembled.meta or {}
+    sched_steps = meta.get("sched_steps", assembled.n_steps)
+    if critical >= 2 * work_steps:
+        classification = "depth-bound"
+    elif work_steps >= 2 * critical:
+        classification = "width-bound"
+    else:
+        classification = "balanced"
+    # per-level width profile: mul ops per dependency level, summarized at
+    # 8 evenly spaced levels (the shape the width-for-depth rewrites read)
+    width_at_level = [0] * (critical + 1)
+    for i, op in enumerate(ops):
+        if op.kind == _MUL:
+            width_at_level[level[i]] += 1
+    profile = []
+    if critical:
+        for q in range(8):
+            profile.append(width_at_level[1 + (q * (critical - 1)) // 7 if critical > 1 else 1])
+    predicted_row_s = (
+        assembled.n_steps * COST_US_PER_STEP * 1e-6
+        * (assembled.n_regs / COST_MODEL_REGS))
+    return {
+        "mul_ops": n_mul,
+        "add_ops": n_add,
+        "sub_ops": n_sub,
+        "critical_path": critical,
+        "work_steps": work_steps,
+        "sched_steps": sched_steps,
+        "padded_steps": assembled.n_steps,
+        "classification": classification,
+        "mul_utilization": (
+            round(n_mul / (sched_steps * w_mul), 4) if sched_steps else 0.0),
+        "lin_utilization": (
+            round(n_lin / (sched_steps * w_lin), 4) if sched_steps else 0.0),
+        "schedule_efficiency": (
+            round(max(critical, work_steps) / sched_steps, 3)
+            if sched_steps else None),
+        "mul_width_profile": profile,
+        "predicted_row_s": round(predicted_row_s, 4),
+    }
+
+
+# ---------------------------------------------------------------------------
+# assembled-program stats (no IR needed — e.g. a .vm_cache pickle)
+# ---------------------------------------------------------------------------
+
+
+def program_stats(assembled) -> Optional[Dict]:
+    """Schedule stats recomputed from the instruction TENSORS of an
+    assembled Program (meta + per-step destination scan): per-unit fill and
+    the register-occupancy curve. Works on cache-loaded programs whose IR
+    is gone; returns None for pre-meta pickles."""
+    import numpy as np
+
+    meta = assembled.meta
+    if not meta:
+        return None
+    msa, msb, msd, lsa, lsb, lsub, lsd = assembled.instr
+    sched = meta["sched_steps"]
+    trash_mul, trash_lin = meta["trash_mul"], meta["trash_lin"]
+    mul_fill = (msd[:sched] < trash_mul).sum(axis=1)
+    lin_fill = (lsd[:sched] < trash_lin).sum(axis=1)
+    # register occupancy: a register is in use from its first write (or
+    # step 0 for inputs/consts) through its last read
+    n_regs = meta["alloc_regs"]
+    first_def = np.full(n_regs, -2, dtype=np.int64)
+    last_read = np.full(n_regs, -2, dtype=np.int64)
+    preloaded = set(int(r) for r in assembled.input_regs)
+    preloaded.update(assembled.const_regs)
+    for t in range(sched):
+        for arr in (msa[t], msb[t], lsa[t], lsb[t]):
+            regs = arr[arr < n_regs]
+            last_read[regs] = t
+        for arr in (msd[t], lsd[t]):
+            regs = arr[(arr >= 0) & (arr < n_regs)]
+            fresh = regs[first_def[regs] == -2]
+            first_def[fresh] = t
+    for r in preloaded:
+        if r < n_regs:
+            first_def[r] = -1
+    for r in assembled.output_regs:
+        if r < n_regs:
+            last_read[int(r)] = sched
+    delta = np.zeros(sched + 2, dtype=np.int64)
+    used = (first_def > -2) & (last_read > -2)
+    starts = np.clip(first_def[used], 0, sched)
+    ends = np.clip(last_read[used], 0, sched)
+    np.add.at(delta, starts, 1)
+    np.add.at(delta, ends + 1, -1)
+    occupancy = np.cumsum(delta)[:sched]
+    return {
+        "sched_steps": int(sched),
+        "mul_ops": int(mul_fill.sum()),
+        "lin_ops": int(lin_fill.sum()),
+        "mul_fill_max": int(mul_fill.max()) if sched else 0,
+        "lin_fill_max": int(lin_fill.max()) if sched else 0,
+        "max_reg_occupancy": int(occupancy.max()) if sched else 0,
+        "alloc_regs": int(n_regs),
+    }
+
+
+# ---------------------------------------------------------------------------
+# the full report
+# ---------------------------------------------------------------------------
+
+
+def analyze_prog(prog, name: str = "<prog>", w_mul: int = 128,
+                 w_lin: int = 128, pad_steps_to: int = 1,
+                 pad_regs_to: int = 1, keep_per_step: bool = False) -> Dict:
+    """Assemble ``prog`` at the given shape and run all three passes.
+    Assembly annotates the ops with step/reg/last-use in place, so the
+    pressure pass reads the REAL schedule the device would run."""
+    assembled = prog.assemble(
+        w_mul=w_mul, w_lin=w_lin,
+        pad_steps_to=pad_steps_to, pad_regs_to=pad_regs_to)
+    bounds = check_bounds(prog)
+    pressure = check_pressure(prog, assembled, keep_per_step=keep_per_step)
+    cost = check_cost(prog, assembled, w_mul, w_lin)
+    findings = (bounds.pop("errors") + bounds.pop("warnings")
+                + pressure.pop("findings"))
+    return {
+        "name": name,
+        "ops": {
+            "total": len(prog.ops),
+            "inputs": len(prog.inputs),
+            "consts": len(prog.consts),
+            "outputs": len(prog.outputs),
+        },
+        "bounds": bounds,
+        "pressure": pressure,
+        "cost": cost,
+        "findings": findings,
+        "errors": sum(1 for f in findings if f["severity"] == "error"),
+        "warnings": sum(1 for f in findings if f["severity"] == "warn"),
+    }
+
+
+# ---------------------------------------------------------------------------
+# the program registry (mirrors the production shapes in ops/bls_backend)
+# ---------------------------------------------------------------------------
+
+
+def registry_programs(tier1_only: bool = False) -> List[Tuple[str, str, int, int]]:
+    """(key, kind, k, fold) for every program vmlint analyzes, named
+    exactly like the obs/programs registry keys so analysis stats merge
+    onto the execution registry. The tier-1 subset keeps to small shapes
+    (fold <= 2, minimal K) so the pytest gate stays cheap; the full set
+    covers the production folds including the chunk-16 rlc_combine and
+    the folded hard part."""
+    small = [
+        ("miller_product", 1, 1),
+        ("aggregate_verify", 2, 1),
+        ("rlc_combine", 2, 1),
+        ("hard_part", 0, 1),
+        ("g1_subgroup", 0, 1),
+        ("g2_subgroup", 0, 1),
+        ("h2g_finish", 0, 1),
+    ]
+    full = [
+        ("miller_product", 16, 2),
+        ("rlc_combine", 16, 1),
+        ("hard_part", 0, 8),
+        ("g1_subgroup", 0, 4),
+        ("g2_subgroup", 0, 8),
+        ("h2g_finish", 0, 4),
+    ]
+    shapes = small if tier1_only else small + full
+    return [(f"{kind}[k={k},fold={fold}]", kind, k, fold)
+            for kind, k, fold in shapes]
+
+
+def run_registry(tier1_only: bool = False, export: bool = True,
+                 progress=None) -> List[Dict]:
+    """Build + analyze every registry program at the PRODUCTION assembly
+    shape (bls_backend's lane widths and padding), optionally exporting
+    summary gauges + per-program stats through the obs/ planes."""
+    from . import bls_backend, vmlib
+
+    reports = []
+    for key, kind, k, fold in registry_programs(tier1_only):
+        if progress is not None:
+            progress(key)
+        prog = vmlib.BUILDERS[kind](k, fold)
+        reports.append(analyze_prog(
+            prog, name=key,
+            w_mul=bls_backend.W_MUL, w_lin=bls_backend.W_LIN,
+            pad_steps_to=bls_backend.PAD_STEPS,
+            # the exact production padding (_program's assemble call) so
+            # n_regs — and the cost model scaled by it — match the
+            # executable shape the device actually runs
+            pad_regs_to=bls_backend._pow2(64)))
+    if export:
+        export_to_obs(reports)
+    return reports
+
+
+def export_to_obs(reports: List[Dict]) -> None:
+    """Publish the analysis summary through the observability planes:
+    per-program stats merge into the obs/programs trace registry (they
+    ride the Chrome trace export's programRegistry key) and the vm.*
+    summary gauges ride profiling.summary() / the /metrics endpoint."""
+    from ..obs import programs as obs_programs
+    from . import profiling
+
+    for r in reports:
+        obs_programs.note_analysis(
+            r["name"],
+            max_live=r["pressure"]["max_live"],
+            critical_path=r["cost"]["critical_path"],
+            classification=r["cost"]["classification"],
+            predicted_row_s=r["cost"]["predicted_row_s"],
+            errors=r["errors"],
+            hazard=r["pressure"]["hazard"],
+        )
+    profiling.set_gauge("vm.analysis_programs", len(reports))
+    profiling.set_gauge("vm.analysis_errors",
+                        sum(r["errors"] for r in reports))
+    profiling.set_gauge("vm.analysis_warnings",
+                        sum(r["warnings"] for r in reports))
+    profiling.set_gauge("vm.analysis_hazards",
+                        sum(1 for r in reports if r["pressure"]["hazard"]))
+    profiling.set_gauge("vm.analysis_max_live",
+                        max((r["pressure"]["max_live"] for r in reports),
+                            default=0))
+
+
+# ---------------------------------------------------------------------------
+# the baseline gate
+# ---------------------------------------------------------------------------
+
+BASELINE_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "VMLINT_BASELINE.json")
+
+# per-program scalars the baseline pins; regressions past the tolerance
+# fail the gate (improvements only warn — update the baseline to ratchet)
+BASELINE_KEYS = ("sched_steps", "critical_path", "max_live", "alloc_regs",
+                 "mul_ops")
+GATE_TOLERANCE = 0.05
+
+
+def baseline_entry(report: Dict) -> Dict:
+    return {
+        "sched_steps": report["pressure"]["sched_steps"],
+        "critical_path": report["cost"]["critical_path"],
+        "max_live": report["pressure"]["max_live"],
+        "alloc_regs": report["pressure"]["alloc_regs"],
+        "mul_ops": report["cost"]["mul_ops"],
+    }
+
+
+def load_baseline(path: str = None) -> Dict:
+    with open(path or BASELINE_PATH) as fh:
+        return json.load(fh)
+
+
+def gate(reports: List[Dict], baseline: Dict,
+         tolerance: float = GATE_TOLERANCE) -> List[str]:
+    """Failure strings (empty = pass): any soundness error or hazard in any
+    report, any program missing from the baseline, any pinned scalar grown
+    past baseline * (1 + tolerance)."""
+    failures = []
+    for r in reports:
+        name = r["name"]
+        for f in r["findings"]:
+            if f["severity"] == "error":
+                where = f" op {f['op']}" if "op" in f else ""
+                failures.append(
+                    f"{name}:{where} [{f['rule']}] {f['detail']}")
+        base = baseline.get(name)
+        if base is None:
+            failures.append(
+                f"{name}: not in VMLINT_BASELINE.json — analyze it and "
+                "commit the entry (tools/vmlint.py --update-baseline)")
+            continue
+        cur = baseline_entry(r)
+        for key in BASELINE_KEYS:
+            if key not in base:
+                continue
+            if cur[key] > base[key] * (1 + tolerance):
+                failures.append(
+                    f"{name}: {key} regressed {base[key]} -> {cur[key]} "
+                    f"(> {tolerance:.0%} tolerance) — fix the regression "
+                    "or consciously re-baseline with --update-baseline")
+    return failures

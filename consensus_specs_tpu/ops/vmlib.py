@@ -934,3 +934,25 @@ def build_hard_part(fold: int = 1) -> Prog:
         for t in range(fold):
             _emit_hard_part(prog, f"i{t}.")
     return prog
+
+
+# ---------------------------------------------------------------------------
+# builder registry
+# ---------------------------------------------------------------------------
+
+# Canonical kind -> builder map, the single resolution point shared by
+# ops/bls_backend._program (the production program cache) and the vmlint
+# static-analysis registry (ops/vm_analysis.registry_programs) — a program
+# kind that exists for execution therefore always exists for analysis.
+# Every entry takes (k, fold); kinds with no per-item size ignore k. The
+# lambdas LATE-bind the module-level names so a monkeypatched builder
+# (tests) is honored.
+BUILDERS = {
+    "miller_product": lambda k, fold=1: build_miller_product(k, fold),
+    "aggregate_verify": lambda k, fold=1: build_aggregate_verify_miller(k, fold),
+    "hard_part": lambda k, fold=1: build_hard_part(fold),
+    "rlc_combine": lambda k, fold=1: build_rlc_combine(k, fold),
+    "g1_subgroup": lambda k, fold=1: build_g1_subgroup_check(fold),
+    "g2_subgroup": lambda k, fold=1: build_g2_subgroup_check(fold),
+    "h2g_finish": lambda k, fold=1: build_h2g_finish(fold),
+}

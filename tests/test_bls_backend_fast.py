@@ -120,3 +120,44 @@ def test_reset_prep_state_clears_pool_latch_and_counters():
     assert bls_backend._POOL_BROKEN is False
     assert all(v == 0 for v in bls_backend.PREP_STATS.values())
     assert profiling.summary()["bls.prep_pool_broken"]["gauge"] == 0.0
+
+
+# -- .vm_cache pruning (ISSUE 6 satellite) -----------------------------------
+
+
+def test_prune_vm_cache_evicts_by_idle_age_and_size(tmp_path):
+    import os
+    import time as _time
+
+    from consensus_specs_tpu.ops.bls_backend import prune_vm_cache
+
+    d = str(tmp_path)
+    now = _time.time()
+    # two stale entries (40 days idle), two fresh, one foreign file
+    for name, age_days, size in (
+        ("v1_aaaa_old1.pkl", 40, 1000),
+        ("v1_aaaa_old2.pkl", 41, 1000),
+        ("v1_bbbb_new1.pkl", 1, 1000),
+        ("v1_bbbb_new2.pkl", 0, 1000),
+    ):
+        p = os.path.join(d, name)
+        with open(p, "wb") as fh:
+            fh.write(b"\x00" * size)
+        os.utime(p, (now - age_days * 86400, now - age_days * 86400))
+    with open(os.path.join(d, "README.txt"), "w") as fh:
+        fh.write("not a cache entry")
+
+    out = prune_vm_cache(max_age_days=30, max_bytes=0, cache_dir=d)
+    assert out["evicted"] == 2 and out["kept"] == 2
+    left = sorted(os.listdir(d))
+    assert left == ["README.txt", "v1_bbbb_new1.pkl", "v1_bbbb_new2.pkl"]
+
+    # size cap: keep only the newest entry's bytes
+    out = prune_vm_cache(max_age_days=0, max_bytes=1000, cache_dir=d)
+    assert out["evicted"] == 1 and out["kept"] == 1
+    assert out["kept_bytes"] == 1000
+    assert sorted(os.listdir(d)) == ["README.txt", "v1_bbbb_new2.pkl"]
+
+    # disabled rules (<= 0) evict nothing
+    out = prune_vm_cache(max_age_days=0, max_bytes=0, cache_dir=d)
+    assert out["evicted"] == 0 and out["kept"] == 1

@@ -72,8 +72,25 @@ def test_emitted_labels_were_actually_found():
     for expected in ("serve.queue_depth", "serve.submit_to_result",
                      "bls.rlc_combines", "bls.vm_cache_hits",
                      "chain.apply_batch", "chain.head_changes",
-                     "chain.reorgs", "chain.dropped_attestations"):
+                     "chain.reorgs", "chain.dropped_attestations",
+                     "vm.analysis_programs", "vm.analysis_errors",
+                     "vm.analysis_hazards", "vm.analysis_max_live"):
         assert expected in found, f"label scan lost {expected}"
+
+
+def test_vm_analysis_gauge_family_is_complete():
+    # every vm.analysis_* gauge the vmlint exporter emits must be
+    # registered, and every registered vm.* gauge must have an emission
+    # site (ops/vm_analysis.export_to_obs) — a renamed analysis metric
+    # can never silently orphan the README table or a scrape rule
+    emitted = {label for label in _emitted_labels()
+               if label.startswith("vm.analysis_")}
+    registered = {n for n in registry.GAUGES if n.startswith("vm.")}
+    assert emitted == registered, (
+        f"vm.analysis gauge drift: emitted-not-registered="
+        f"{emitted - registered}, registered-not-emitted="
+        f"{registered - emitted}"
+    )
 
 
 def test_chain_gauge_family_is_complete():

@@ -121,3 +121,153 @@ def test_non_function_callees_are_skipped(speclint, tmp_path):
         tmp_path,
     )
     assert speclint.check_call_signatures(ns, "<seeded>") == []
+
+
+# ---------------------------------------------------------------------------
+# duplicate-definition sweep (pyflakes F811 class)
+# ---------------------------------------------------------------------------
+
+
+def _dup_findings(speclint, src):
+    import ast
+
+    src = textwrap.dedent(src)
+    tree = ast.parse(src)
+    noqa = {i + 1 for i, line in enumerate(src.splitlines())
+            if "noqa" in line}
+    return speclint.check_duplicate_defs(tree, "mod.py", noqa)
+
+
+def test_duplicate_test_function_is_caught(speclint):
+    findings = _dup_findings(
+        speclint,
+        """
+        def test_x():
+            assert True
+
+        def test_x():  # the classic: the first test silently never runs
+            assert False
+        """,
+    )
+    assert len(findings) == 1
+    assert "test_x" in findings[0] and "line 2" in findings[0]
+
+
+def test_duplicate_class_and_method_are_caught(speclint):
+    findings = _dup_findings(
+        speclint,
+        """
+        class C:
+            def m(self):
+                return 1
+
+            def m(self):
+                return 2
+
+        class C:
+            pass
+        """,
+    )
+    assert len(findings) == 2
+    assert any("'m'" in f for f in findings)
+    assert any("'C'" in f for f in findings)
+
+
+def test_branch_split_definitions_are_legal(speclint):
+    findings = _dup_findings(
+        speclint,
+        """
+        try:
+            from fast import impl
+        except ImportError:
+            def impl():
+                return None
+
+        if True:
+            def helper():
+                return 1
+        else:
+            def helper():
+                return 2
+        """,
+    )
+    assert findings == []
+
+
+def test_duplicate_inside_else_branch_is_caught(speclint):
+    findings = _dup_findings(
+        speclint,
+        """
+        try:
+            import fast
+        except ImportError:
+            pass
+        else:
+            def test_x():
+                assert True
+
+            def test_x():
+                assert False
+        """,
+    )
+    assert len(findings) == 1 and "test_x" in findings[0]
+
+
+def test_property_setter_idiom_is_exempt(speclint):
+    findings = _dup_findings(
+        speclint,
+        """
+        class C:
+            @property
+            def x(self):
+                return self._x
+
+            @x.setter
+            def x(self, v):
+                self._x = v
+        """,
+    )
+    assert findings == []
+
+
+def test_mark_decorated_duplicates_are_still_caught(speclint):
+    # the exemption is ONLY the @x.setter accumulator idiom; a foreign
+    # dotted decorator must not shield a shadowing redefinition
+    findings = _dup_findings(
+        speclint,
+        """
+        import pytest
+
+        @pytest.mark.slow
+        def test_x():
+            assert True
+
+        @pytest.mark.slow
+        def test_x():
+            assert False
+        """,
+    )
+    assert len(findings) == 1 and "test_x" in findings[0]
+
+
+def test_noqa_suppresses_duplicate_definition(speclint):
+    findings = _dup_findings(
+        speclint,
+        """
+        def f():
+            return 1
+
+        def f():  # noqa: deliberate override
+            return 2
+        """,
+    )
+    assert findings == []
+
+
+def test_repo_tooling_is_covered_by_the_walk(speclint):
+    # the satellite contract: the source walk lints tools/ and bench.py,
+    # not just the package — a duplicate def there must be reachable
+    files = list(speclint._py_files())
+    names = {os.path.basename(f) for f in files}
+    assert "bench.py" in names and "speclint.py" in names
+    assert any(os.sep + "tools" + os.sep in f for f in files)

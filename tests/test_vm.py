@@ -195,3 +195,71 @@ def test_f12_cyclotomic_square_vs_oracle():
     g = g.frobenius().frobenius() * g
     got = _f12_run(_f12_prog(vmlib.f12_cyclotomic_square), g)
     assert got == g * g
+
+
+# ---------------------------------------------------------------------------
+# the assembler's own bound machinery
+# ---------------------------------------------------------------------------
+
+
+def test_inp_loose_bound_accepts_another_programs_output():
+    """The RLC feed path: program 1's out() is compressed but LOOSE
+    (< 2^382, not < p); program 2 declares that magnitude via inp(bound=)
+    and must still compute correctly when the raw limbs are fed straight
+    back in with no host canonicalization."""
+    p1 = vm.Prog()
+    a, b = p1.inp("a"), p1.inp("b")
+    p1.out(a * b, "r")
+    av, bv = rng.randrange(O.P), rng.randrange(O.P)
+    pr1 = p1.assemble(**BUCKET)
+    raw = vm.execute(
+        pr1, {"a": fq.to_mont_int(av), "b": fq.to_mont_int(bv)}
+    )["r"]  # loose Montgomery limbs, NOT reduced mod p
+
+    p2 = vm.Prog()
+    x = p2.inp("x", bound=vmlib.RLC_F_BOUND)
+    y = p2.inp("y")
+    assert p2.ops[x.idx].bound == vmlib.RLC_F_BOUND  # declaration recorded
+    p2.out(x * y + x, "r")
+    yv = rng.randrange(O.P)
+    got = vm.execute(
+        p2.assemble(**BUCKET), {"x": raw, "y": fq.to_mont_int(yv)}
+    )["r"]
+    expect = (av * bv * yv + av * bv) % O.P
+    assert fq.from_mont_limbs(got) == expect
+
+
+def test_b_cap_assertion_fires_on_overdeclared_input():
+    """_B_CAP guards declared input bounds too: a declaration at the
+    15-limb capacity can never be carry-safe."""
+    prog = vm.Prog()
+    with pytest.raises(AssertionError, match="missing compress"):
+        prog.inp("a", bound=1 << 420)
+
+
+def test_sub_auto_compresses_loose_operands():
+    """Loose-declared operands past the borrowless-subtract preconditions
+    (subtrahend <= MP, minuend headroom) must be auto-compressed, keeping
+    the result exact."""
+    prog = vm.Prog()
+    a = prog.inp("a", bound=1 << 412)
+    b = prog.inp("b", bound=1 << 412)  # far above the MP subtrahend cap
+    prog.out(a - b, "r")
+    assert all(op.bound < (1 << 420) for op in prog.ops)
+    av, bv = rng.randrange(O.P), rng.randrange(O.P)
+    got = run(prog, dict(a=av, b=bv))["r"]
+    assert got == (av - bv) % O.P
+
+
+def test_cse_key_symmetry_for_commutative_ops():
+    prog = vm.Prog()
+    a, b = prog.inp("a"), prog.inp("b")
+    # commutative: both operand orders must hit one op
+    assert (a * b).idx == (b * a).idx
+    assert (a + b).idx == (b + a).idx
+    # and repeats add no ops at all
+    n = len(prog.ops)
+    assert (a * b).idx == (b * a).idx
+    assert len(prog.ops) == n
+    # subtraction is NOT commutative: orders must stay distinct
+    assert (a - b).idx != (b - a).idx

@@ -4,12 +4,21 @@ Fills the role of the reference's flake8 + strict-mypy lint of the
 GENERATED spec (reference Makefile:133-136, linter.ini) in an image that
 ships neither tool (no installs allowed). Two layers:
 
-1. SOURCE checks over every repo .py file (symtable-based, pyflakes-class):
+1. SOURCE checks over every repo .py file (symtable-based, pyflakes-class)
+   — the walk covers the package, tests/, tools/, bench.py and
+   __graft_entry__.py, so the repo's own tooling is linted too:
    - undefined names: a symbol referenced in any scope that is neither
      local, nor enclosing, nor module-level, nor a builtin. This is the
      bug class that silently breaks exec-layered namespaces.
    - unused imports (module scope; `__init__.py` re-export modules and
      star-importing files are exempt, `# noqa` suppresses a line).
+   - duplicate definitions (pyflakes F811): two `def`/`class` statements
+     with the same name in the SAME statement body (module, class, or
+     function) — the later silently shadows the earlier, the classic
+     two-`def test_x` bug that makes a test never run. Branch-split
+     redefinitions (if/else, try/except) live in different body lists and
+     are not flagged; `@x.setter`-style attribute-decorated redefs are
+     exempt.
 
 2. BUILT-SPEC checks over every (fork, preset) module the builder emits —
    the analog of the reference type-checking its generated spec:
@@ -78,6 +87,50 @@ def _collect_defined_through(table, defined):
     return out
 
 
+def check_duplicate_defs(tree, rel: str, noqa):
+    """F811-class sweep: same-name `def`/`class` statements in one
+    statement body. Bodies are scanned per-list, so `if`/`try` branch
+    variants never collide; a redefinition whose decorator is an attribute
+    access (`@prop.setter`, `@fn.register`) is the accumulator idiom and
+    is exempt."""
+    findings = []
+    for node in ast.walk(tree):
+        # every statement list is its own scan scope: body, else-branches
+        # (If/For/While/Try orelse) and finally blocks — a dup WITHIN one
+        # list shadows; defs split ACROSS lists are branch variants
+        for body in (getattr(node, "body", None),
+                     getattr(node, "orelse", None),
+                     getattr(node, "finalbody", None)):
+            if not isinstance(body, list):
+                continue
+            seen = {}
+            for stmt in body:
+                if not isinstance(stmt, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef,
+                                         ast.ClassDef)):
+                    continue
+                first = seen.get(stmt.name)
+                # the accumulator idiom only: a decorator rooted at the
+                # redefined name ITSELF (@x.setter / @x.register for def x).
+                # Any other dotted decorator (@pytest.mark.slow, ...) is
+                # not an exemption — two decorated test defs still shadow.
+                self_decorated = any(
+                    isinstance(d, ast.Attribute)
+                    and isinstance(d.value, ast.Name)
+                    and d.value.id == stmt.name
+                    for d in stmt.decorator_list
+                )
+                if (first is not None and not self_decorated
+                        and stmt.lineno not in noqa):
+                    findings.append(
+                        f"{rel}:{stmt.lineno}: duplicate definition of "
+                        f"'{stmt.name}' (first defined at line {first}; "
+                        "the later definition silently shadows it)"
+                    )
+                seen.setdefault(stmt.name, stmt.lineno)
+    return findings
+
+
 def check_source_file(path: str):
     findings = []
     src = open(path).read()
@@ -105,6 +158,11 @@ def check_source_file(path: str):
             use_lines.setdefault(node.id, node.lineno)
 
     module_names = _collect_defined_through(top, set())
+
+    # duplicate-definition sweep runs for EVERY file (specsrc included:
+    # fork layering overrides across files by design, but a redefinition
+    # within one module body is always a silent shadow)
+    findings += check_duplicate_defs(tree, rel, noqa)
 
     if not has_star and not in_specsrc:
         # undefined-name sweep: FREE (global-implicit) symbols in any scope
