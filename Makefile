@@ -122,10 +122,13 @@ serve-bench:
 
 # serve bench with the full observability plane on: per-request span
 # tracing exported as Chrome trace-event JSON (open serve_trace.json in
-# chrome://tracing or Perfetto) and the /metrics + /snapshot + /healthz
-# endpoint live on an ephemeral port during the run
+# chrome://tracing or Perfetto — device-occupancy and flight-recorder
+# lanes included), the flight recorder's JSONL journal dumped next to it,
+# and the /metrics + /snapshot + /healthz (SLO-bearing) + /flightdump
+# endpoint live on an ephemeral port during the run. CI uploads
+# serve_trace.json as a build artifact.
 serve-trace:
-	JAX_PLATFORMS=cpu SERVE_METRICS_PORT=0 python bench.py --mode serve --trace serve_trace.json
+	JAX_PLATFORMS=cpu SERVE_METRICS_PORT=0 python bench.py --mode serve --trace serve_trace.json --flight serve_flight.jsonl
 
 # prep-only microbenchmark: the batched input codec (ops/codec.py —
 # decompression, subgroup checks, hash-to-G2) vs the per-item pure-Python

@@ -48,8 +48,12 @@ hit rate, p50/p95/p99 submit->result latency, and the prep-vs-device time
 split per flush (knobs: SERVE_* env vars, see serve/load.py). Add
 `--trace out.json` to record per-request spans (queue-wait/prep/device/
 combine/finalize) + VM program executions and export Chrome trace-event
-JSON; SERVE_METRICS_PORT=<port|0> additionally serves Prometheus
-`/metrics` + `/snapshot` + `/healthz` during the run (obs/).
+JSON (device-occupancy and flight-recorder lanes included when those
+planes are armed); `--flight out.jsonl` arms the flight recorder
+(obs/flight.py) and dumps its structured-event journal after the run;
+SERVE_METRICS_PORT=<port|0> additionally serves Prometheus `/metrics` +
+`/snapshot` + `/healthz` (now SLO-state-bearing) + `/flightdump` during
+the run (obs/).
 
 `--mode codec` is the prep-only microbenchmark: the batched input codec
 (ops/codec.py) vs the per-item pure-Python prep path, items/sec over
@@ -412,9 +416,15 @@ def main():
         # `--trace out.json` turns on the span tracer for the whole run
         # and exports Chrome trace-event JSON (pipeline spans + VM program
         # executions + per-program registry) after the load completes
+        # `--flight out.jsonl` arms the flight recorder for the run and
+        # dumps its JSONL journal afterwards (the on-demand forensic dump;
+        # the recorder also auto-dumps on a serve-plane fault)
         trace_path = _cli_opt("--trace")
+        flight_path = _cli_opt("--flight")
         if trace_path:
             os.environ["CONSENSUS_SPECS_TPU_TRACE"] = "1"
+        if flight_path:
+            os.environ["CONSENSUS_SPECS_TPU_FLIGHT"] = "1"
         from consensus_specs_tpu.utils.jax_env import force_cpu
 
         force_cpu()
@@ -428,6 +438,12 @@ def main():
             # monotone count (NOT the ring length): a scaled run traces
             # more requests than the ring retains spans for
             result["trace_requests"] = tracing.global_tracer().finished_total()
+        if flight_path:
+            from consensus_specs_tpu.obs import flight
+
+            rec = flight.global_recorder()
+            result["flight"] = rec.dump(flight_path, reason="bench_flight")
+            result["flight_events"] = rec.counters()["events"]
         _emit_result(result)
         return
 

@@ -378,13 +378,17 @@ def run_head_bench() -> dict:
     """Drive the replay across HEAD_TREE_SIZES; returns bench.py's result
     dict (ready for ``_emit_result``)."""
     from ..builder import build_spec_module
-    from ..obs import programs as obs_programs
+    from ..obs import programs as obs_programs, slo
     from ..ops import profiling
     from ..serve.load import plan_gossip_faults
     from ..test.helpers.genesis import create_genesis_state
 
     profiling.reset()
     obs_programs.export_gauges()
+    slo.reset_global()
+    # baseline checkpoint: the final slo section's burn windows measure
+    # this run (an empty ring would diff the end state against itself)
+    slo.global_tracker().evaluate()
 
     sizes = [int(s) for s in os.environ.get(
         "HEAD_TREE_SIZES", "64,256,1024").split(",") if s.strip()]
@@ -483,6 +487,10 @@ def run_head_bench() -> dict:
         speedup_at_largest=largest["speedup"],
         trees=trees,
         per_mode_best=per_mode_best,
+        # SLO state over the replay's chain.apply_batch histogram (the
+        # serve objective rides along vacuously when no serve traffic
+        # ran) — the section tools/bench_compare.py gates
+        slo=slo.global_tracker().bench_section(),
         profile=profiling.summary(),
     )
     if "metrics_scrape_lines" in largest:

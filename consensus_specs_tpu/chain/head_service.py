@@ -42,7 +42,7 @@ import time
 from collections import deque
 from typing import List, Optional, Tuple
 
-from ..obs import tracing
+from ..obs import flight, tracing
 from .metrics import ChainMetrics
 from .proto_array import ProtoForkChoice
 
@@ -96,6 +96,10 @@ class HeadService:
         self._service = service
         self.metrics = metrics or ChainMetrics()
         self._tracer = tracer if tracer is not None else tracing.maybe_tracer()
+        # flight recorder (obs/flight.py): chain-plane forensics — block
+        # arrivals, deferrals, drops, prunes. None when disabled; every
+        # site guards on `is not None` (the tracer's zero-cost contract)
+        self._flight = flight.maybe_recorder()
         if differential is None:
             differential = os.environ.get(DIFF_ENV, "0") not in ("", "0")
         self._differential = differential
@@ -174,6 +178,10 @@ class HeadService:
             _cp(state.finalized_checkpoint),
         )
         self.metrics.note_block()
+        if self._flight is not None:
+            self._flight.note("chain", "on_block", slot=int(block.slot),
+                              root=bytes(root).hex()[:16],
+                              deferred_pending=len(self._deferred))
         self._refresh_checkpoints()
         batch = list(block.body.attestations) if process_attestations else []
         retry = list(self._deferred)
@@ -273,9 +281,18 @@ class HeadService:
                 self._deferred.append((att, attempts + 1))
                 summary["deferred"] += 1
                 self.metrics.note_deferred(len(self._deferred))
+                if self._flight is not None:
+                    self._flight.note("chain", "defer",
+                                      slot=int(att.data.slot),
+                                      attempts=attempts + 1,
+                                      pending=len(self._deferred))
             else:  # never valid, retries exhausted, or buffer full
                 summary["dropped"] += 1
                 self.metrics.note_dropped()
+                if self._flight is not None:
+                    self._flight.note("chain", "drop",
+                                      slot=int(att.data.slot),
+                                      verdict=verdict)
 
         for att in attestations:
             route(att, 0, was_deferred=False)
@@ -296,6 +313,11 @@ class HeadService:
             else:
                 summary["dropped"] += 1
                 self.metrics.note_dropped()
+                if self._flight is not None:
+                    self._flight.note(
+                        "chain", "drop",
+                        slot=int(item.attestation.data.slot),
+                        verdict="bad_signature")
         t2 = time.perf_counter()
 
         for item, was_deferred in verified:
@@ -360,6 +382,9 @@ class HeadService:
         pruned = self.fc.update_checkpoints(_cp(jc), _cp(fin), balances)
         if pruned:
             self.metrics.note_pruned(pruned)
+            if self._flight is not None:
+                self._flight.note("chain", "prune", nodes=pruned,
+                                  finalized_epoch=_cp(fin)[0])
         self._cp_key = key
         return True
 

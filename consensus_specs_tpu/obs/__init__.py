@@ -4,16 +4,30 @@ The serve pipeline and the VM execution engine record into this package;
 it exports three surfaces:
 
 - ``tracing``   — per-request spans (queue_wait/prep/device/combine/
-                  finalize) in a bounded ring with slow-request exemplar
+                  finalize + the chain plane's validate/sig_wait/apply/
+                  sweep) in a bounded ring with slow-request exemplar
                   pinning, plus VM execution events; Chrome trace-event
                   export (``dump_trace`` / ``bench.py --mode serve
-                  --trace``). Opt-in via ``CONSENSUS_SPECS_TPU_TRACE=1``.
-- ``registry``  — the canonical metric-name registry (drift-gated by
-                  tier-1) and the Prometheus text renderer.
+                  --trace``) composing the device-occupancy and
+                  flight-recorder lanes. Opt-in ``CONSENSUS_SPECS_TPU_TRACE=1``.
+- ``registry``  — the canonical metric-name registry + span-stage
+                  registry (both drift-gated by tier-1) and the
+                  Prometheus text renderer (histogram exposition incl.).
 - ``exposition``— opt-in stdlib HTTP endpoint: ``/metrics`` (Prometheus),
-                  ``/snapshot`` (ServeMetrics JSON), ``/healthz``.
+                  ``/snapshot`` (ServeMetrics JSON), ``/healthz``
+                  (liveness + SLO state), ``/flightdump`` (JSONL journal).
 - ``programs``  — per-VM-program registry (steps, register-file size,
                   assembly time, ``.vm_cache/`` hit/miss).
+- ``hist``      — mergeable log-bucketed histograms (fixed base-2/
+                  8-subbucket bounds: exact cross-device/node merges) —
+                  the latency metric type behind ``ops/profiling``.
+- ``devices``   — per-device occupancy ledger (busy/idle timelines,
+                  ``device[<lane>]`` utilization gauges, Chrome lane).
+- ``flight``    — cross-plane flight recorder (bounded ring journal of
+                  serve/chain/vm events, JSONL dump on fault/demand).
+- ``slo``       — declared latency objectives + multi-window burn rates
+                  over the histograms; feeds ``/healthz`` and the bench
+                  JSON ``slo`` sections ``bench_compare`` gates.
 
 Import cost is stdlib-only; nothing here imports jax, and ``ops`` modules
 are only reached lazily at render/record time (so ops <-> obs never

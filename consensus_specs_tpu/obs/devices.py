@@ -1,0 +1,220 @@
+"""Per-device occupancy ledger: who was busy, when, and how much.
+
+ROADMAP item 1 (mesh-sharded verify) needs exactly one number before
+``shard_map`` partitioning can be tuned: per-device utilization — is the
+mesh actually kept busy, or does one hot device serialize the batch while
+seven idle? PR 4's occupancy gauges were batch-shape ratios (filled rows /
+padded rows); this ledger tracks WALL TIME per device lane instead:
+
+- ``ops/vm.execute`` notes every device program run against the lanes it
+  occupied (all mesh devices for a sharded run, device 0 otherwise);
+- the serve worker's PREP stage notes its host-codec time on the
+  dedicated ``host`` lane, so the prep-vs-device pipeline overlap is
+  visible as two lanes with overlapping busy intervals.
+
+Each lane keeps cumulative busy seconds plus a bounded ring of recent
+``(t0, t1, label)`` intervals — the busy/idle TIMELINE, exported as an
+occupancy lane (pid 3) in the Chrome trace (``tracing.dump_trace``).
+Utilization gauges publish per lane through the dynamic ``device[<i>]``
+metric family plus ``device.count``/``device.busy_s`` statics.
+
+Enabled by default (cost: one lock at device-call scale, never per
+submit); ``CONSENSUS_SPECS_TPU_DEVICES=0`` turns the ledger off, making
+``maybe_ledger()`` return None so every note site skips on a None check.
+"""
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+DEVICES_ENV = "CONSENSUS_SPECS_TPU_DEVICES"
+
+HOST_LANE = "host"  # the serve worker's prep stage (not a device)
+
+# per-lane interval ring: enough for a bench run's flushes; older busy
+# time stays in the cumulative counter when the ring churns
+INTERVAL_CAPACITY = 1024
+
+
+def enabled() -> bool:
+    """Dynamic env read, same contract as ``tracing.trace_enabled`` —
+    flipping the env takes effect on the next note/snapshot."""
+    return os.environ.get(DEVICES_ENV, "1") not in ("", "0")
+
+
+class _Lane:
+    __slots__ = ("busy_s", "events", "intervals")
+
+    def __init__(self):
+        self.busy_s = 0.0
+        self.events = 0
+        self.intervals: "deque[Tuple[float, float, str]]" = deque(
+            maxlen=INTERVAL_CAPACITY)
+
+
+class DeviceLedger:
+    """Busy-interval accumulator keyed by lane (device index or 'host')."""
+
+    def __init__(self, clock=time.perf_counter):
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._t_start = clock()
+        self._lanes: Dict[object, _Lane] = {}
+
+    # -- recording -----------------------------------------------------------
+
+    def note_busy(self, lane, t0: float, t1: float, label: str = "") -> None:
+        """One busy interval on ``lane`` (int device index or 'host')."""
+        if t1 < t0:
+            t0, t1 = t1, t0
+        with self._lock:
+            entry = self._lanes.get(lane)
+            if entry is None:
+                entry = self._lanes[lane] = _Lane()
+            entry.busy_s += t1 - t0
+            entry.events += 1
+            entry.intervals.append((t0, t1, label))
+
+    def note_execution(self, mesh, t0: float, seconds: float,
+                       label: str = "vm") -> None:
+        """One VM program execution: busy on every mesh device, or on
+        device 0 for an unsharded run (the default-device dispatch)."""
+        lanes: List[int]
+        if mesh is None:
+            lanes = [0]
+        else:
+            try:
+                lanes = sorted({int(d.id) for d in mesh.devices.flat})
+            except Exception:
+                lanes = [0]
+        for lane in lanes:
+            self.note_busy(lane, t0, t0 + seconds, label)
+
+    # -- reading -------------------------------------------------------------
+
+    @staticmethod
+    def _lane_key(lane) -> str:
+        return str(lane)
+
+    def utilization(self, now: Optional[float] = None) -> Dict[str, float]:
+        if now is None:
+            now = self._clock()
+        elapsed = max(1e-9, now - self._t_start)
+        with self._lock:
+            return {
+                self._lane_key(lane): min(1.0, entry.busy_s / elapsed)
+                for lane, entry in self._lanes.items()
+            }
+
+    def snapshot(self, now: Optional[float] = None) -> Dict:
+        """The serve/head bench JSON's ``devices`` section."""
+        if now is None:
+            now = self._clock()
+        elapsed = max(1e-9, now - self._t_start)
+        with self._lock:
+            lanes = {
+                self._lane_key(lane): {
+                    "busy_s": round(entry.busy_s, 4),
+                    "utilization": round(min(1.0, entry.busy_s / elapsed), 4),
+                    "events": entry.events,
+                }
+                for lane, entry in sorted(self._lanes.items(),
+                                          key=lambda kv: str(kv[0]))
+            }
+        return {"elapsed_s": round(elapsed, 3), "lanes": lanes}
+
+    def timeline(self) -> List[Tuple[str, str, float, float]]:
+        """Recent busy intervals: (lane, label, t0, t1), lane-grouped —
+        the Chrome occupancy lane's source."""
+        with self._lock:
+            out = []
+            for lane, entry in sorted(self._lanes.items(),
+                                      key=lambda kv: str(kv[0])):
+                for t0, t1, label in entry.intervals:
+                    out.append((self._lane_key(lane), label, t0, t1))
+            return out
+
+    def export_gauges(self) -> None:
+        """Publish ``device.count``/``device.busy_s`` + per-lane
+        utilization through the dynamic ``device[<lane>]`` family."""
+        from ..ops import profiling
+
+        util = self.utilization()
+        with self._lock:
+            total_busy = sum(e.busy_s for e in self._lanes.values())
+            n = len(self._lanes)
+        profiling.set_gauge("device.count", n)
+        profiling.set_gauge("device.busy_s", total_busy)
+        for lane, u in sorted(util.items()):
+            profiling.set_gauge(f"device[{lane}]", u)
+
+
+# -- process-global ledger ----------------------------------------------------
+
+_global_lock = threading.Lock()
+_global: Optional[DeviceLedger] = None
+
+
+def global_ledger() -> DeviceLedger:
+    global _global
+    with _global_lock:
+        if _global is None:
+            _global = DeviceLedger()
+        return _global
+
+
+def maybe_ledger() -> Optional[DeviceLedger]:
+    """The global ledger when enabled, else None — note sites guard on a
+    plain None check (the PR 4 zero-cost-off bar)."""
+    return global_ledger() if enabled() else None
+
+
+def reset_global() -> None:
+    """Fresh ledger (bench runs reset so utilization denominators start
+    at the run, not at process birth)."""
+    global _global
+    with _global_lock:
+        _global = None
+
+
+def earliest_timestamp() -> Optional[float]:
+    """Oldest retained interval start (perf_counter seconds), for the
+    trace exporter's epoch rewind; None when disabled/empty."""
+    if not enabled() or _global is None:
+        return None
+    timeline = _global.timeline()
+    return min((t0 for _l, _lb, t0, _t1 in timeline), default=None)
+
+
+def chrome_events(us_fn) -> List[Dict]:
+    """The occupancy lane for a Chrome trace export: one pid-3 row per
+    lane, one complete ("X") event per busy interval. ``us_fn`` maps
+    perf_counter seconds to trace microseconds (the exporting tracer's
+    epoch). Empty when the ledger is disabled or never recorded."""
+    if not enabled() or _global is None:
+        return []
+    timeline = _global.timeline()
+    if not timeline:
+        return []
+    events: List[Dict] = [
+        {"ph": "M", "name": "process_name", "pid": 3,
+         "args": {"name": "device-occupancy"}},
+    ]
+    tids: Dict[str, int] = {}
+    for lane, label, t0, t1 in timeline:
+        tid = tids.get(lane)
+        if tid is None:
+            tid = tids[lane] = len(tids) + 1
+            events.append({
+                "ph": "M", "name": "thread_name", "pid": 3, "tid": tid,
+                "args": {"name": (f"device-{lane}" if lane != HOST_LANE
+                                  else "host-prep")},
+            })
+        events.append({
+            "name": label or "busy", "cat": "device", "ph": "X",
+            "pid": 3, "tid": tid, "ts": us_fn(t0),
+            "dur": round(max(0.0, t1 - t0) * 1e6, 3),
+            "args": {"lane": lane},
+        })
+    return events

@@ -2,11 +2,18 @@
 threads, no third-party deps — the container has no prometheus_client).
 
 Routes:
-  ``/metrics``  Prometheus text format 0.0.4 (``obs/registry.py`` renders
-                the live ``profiling.summary()`` snapshot);
-  ``/snapshot`` the wired ``ServeMetrics.snapshot()`` JSON (or the
-                profiling summary when no service is attached);
-  ``/healthz``  liveness.
+  ``/metrics``    Prometheus text format 0.0.4 (``obs/registry.py`` renders
+                  the live ``profiling.summary()`` snapshot, latency
+                  histograms included);
+  ``/snapshot``   the wired ``ServeMetrics.snapshot()`` JSON (or the
+                  profiling summary when no service is attached);
+  ``/healthz``    liveness AND objective state: the body carries the SLO
+                  tracker's evaluation (``obs/slo.py`` — per-objective
+                  attainment, burn rates, ok flags) with a top-level
+                  ``ok`` that is the AND over declared objectives, so a
+                  probe distinguishes "alive" from "alive and in budget";
+  ``/flightdump`` the flight recorder's journal as JSONL
+                  (``obs/flight.py``; 404 when the recorder is disabled).
 
 Explicitly opt-in: nothing in the serve plane binds a port unless
 ``start_exposition`` is called (the serve bench does it when
@@ -43,8 +50,22 @@ class _Handler(BaseHTTPRequestHandler):
                                   sort_keys=True).encode()
                 ctype = "application/json"
             elif path == "/healthz":
-                body = b'{"ok": true}'
+                from . import slo
+
+                body = json.dumps(slo.global_tracker().healthz(),
+                                  sort_keys=True).encode()
                 ctype = "application/json"
+            elif path == "/flightdump":
+                from . import flight
+
+                rec = flight.maybe_recorder()
+                if rec is None:
+                    self.send_error(
+                        404, "flight recorder disabled "
+                        "(set CONSENSUS_SPECS_TPU_FLIGHT=1)")
+                    return
+                body = rec.to_jsonl(reason="flightdump_endpoint").encode()
+                ctype = "application/x-ndjson"
             else:
                 self.send_error(404, "unknown path")
                 return

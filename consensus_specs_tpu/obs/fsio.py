@@ -1,0 +1,28 @@
+"""Shared file-system helper for the obs exports (no deps, leaf module).
+
+Every artifact the observability plane writes — Chrome traces, flight
+JSONL journals — uses the same write-tmp-then-rename shape so a reader
+(CI artifact upload, a mid-run scrape of the dump path) never sees a
+half-written file. One implementation, so a future hardening (fsync
+before rename, orphaned-.tmp cleanup) lands everywhere at once.
+"""
+import os
+
+
+def atomic_write_text(path: str, body: str) -> str:
+    """Write ``body`` to ``path`` atomically (tmp + rename); returns
+    ``path``. A write failure removes its own ``<path>.<pid>.tmp``; only
+    a hard kill mid-write can orphan one (nothing sweeps those — the
+    ``.pkl``-scoped ``prune_vm_cache`` sweep covers .vm_cache/ only)."""
+    tmp = f"{path}.{os.getpid()}.tmp"
+    try:
+        with open(tmp, "w") as fh:
+            fh.write(body)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+    return path

@@ -25,6 +25,19 @@ PROM_PREFIX = "consensus_specs_tpu_"
 
 # -- the registry -----------------------------------------------------------
 
+# every span stage any plane may stamp onto a trace, by plane — the
+# canonical list ``obs/tracing.py`` re-exports (STAGES/CHAIN_STAGES) and
+# the trace-coverage gate in tests/test_obs.py walks: a plane that
+# registers stages here but never exports them in a trace fails tier-1,
+# so future planes cannot silently ship untraced
+SPAN_STAGES: Dict[str, tuple] = {
+    # the serve pipeline's five per-request stages (`combine` only appears
+    # on RLC-routed flushes)
+    "serve": ("queue_wait", "prep", "device", "combine", "finalize"),
+    # the chain plane's per-gossip-batch stages (PR 5)
+    "chain": ("validate", "sig_wait", "apply", "sweep"),
+}
+
 GAUGES: Dict[str, str] = {
     "serve.queue_depth": "ingress queue depth after the last enqueue/flush",
     "serve.cache_hit_rate": "share of non-eager submits answered by the "
@@ -68,6 +81,29 @@ GAUGES: Dict[str, str] = {
                            "register-pressure hazard rule",
     "vm.analysis_max_live": "max register pressure (live values at one "
                             "step) across the analyzed programs",
+    "bls.vm_cache_pruned_entries": "entries `make vm-cache-prune` evicted "
+                                   "from .vm_cache/ (last prune in this "
+                                   "process)",
+    "bls.vm_cache_pruned_bytes": "bytes reclaimed by the last "
+                                 ".vm_cache/ prune in this process",
+    "hist.families": "latency-histogram families tracked by this process "
+                     "(mergeable log-bucketed distributions)",
+    "device.count": "devices (plus the host prep lane) the occupancy "
+                    "ledger has seen busy",
+    "device.busy_s": "total busy seconds across all device lanes since "
+                     "ledger start/reset",
+    "flight.events": "structured events the flight recorder has journaled "
+                     "(ring-bounded; see flight.dropped)",
+    "flight.dropped": "flight-recorder events overwritten by ring churn "
+                      "(raise CONSENSUS_SPECS_TPU_FLIGHT_RING)",
+    "flight.dumps": "flight-recorder JSONL dumps written (on fault or on "
+                    "demand)",
+    "slo.ok": "1 when every declared objective is currently met "
+              "(vacuously 1 with no observations)",
+    "slo.violations": "declared objectives currently out of budget",
+    "slo.worst_burn_rate": "highest burn rate across objectives and "
+                           "windows (1.0 = consuming error budget exactly "
+                           "at the sustainable rate)",
 }
 
 STATS: Dict[str, str] = {
@@ -87,7 +123,8 @@ STATS: Dict[str, str] = {
 
 LATENCIES: Dict[str, str] = {
     "serve.submit_to_result": "submit()->Future-resolution latency "
-                              "(p50/p95/p99 over a bounded reservoir)",
+                              "(p50/p95/p99 over a mergeable log-bucket "
+                              "histogram)",
     "chain.apply_batch": "per-gossip-batch apply latency: validate + "
                          "signature wait + latest-message apply + sweep",
 }
@@ -98,6 +135,9 @@ LATENCIES: Dict[str, str] = {
 DYNAMIC_PREFIXES: Dict[str, tuple] = {
     "vm[": ("vm_execute", "per-program VM execution timing, labelled "
                           "vm[steps=...,regs=...,batch=...,sharded=...]"),
+    "device[": ("device_busy_frac", "per-device occupancy (busy seconds / "
+                                    "elapsed), labelled device[<index>] "
+                                    "(device[host] is the prep lane)"),
 }
 
 
@@ -149,15 +189,27 @@ def render_prometheus() -> str:
     """Prometheus text format 0.0.4 over the live profiling snapshot.
 
     Stat accumulators render as ``_calls_total``/``_seconds_total``
-    counters + a ``_max_seconds`` gauge; latency reservoirs render as a
-    summary (quantiles 0.5/0.95/0.99 + ``_sum``/``_count``) + a
-    ``_max_seconds`` gauge; gauges render as-is. HELP/TYPE headers are
+    counters + a ``_max_seconds`` gauge; latency histograms render TWICE —
+    the PR 4 summary surface (quantiles 0.5/0.95/0.99 + ``_sum``/
+    ``_count``, so every existing dashboard keeps working) AND a full
+    Prometheus histogram family (``_hist_bucket`` with ``le`` labels +
+    ``_hist_sum``/``_hist_count``) whose fixed log-bucket bounds merge
+    exactly across processes; gauges render as-is. HELP/TYPE headers are
     emitted once per family even when dynamic labels fan it out into many
     series.
     """
     from ..ops import profiling
 
-    snap = profiling.summary()
+    # three one-lock reads, ONE histogram snapshot per latency family:
+    # the summary quantile lines and the histogram lines below derive
+    # from the same detached copy, so the two families always agree on
+    # count/sum within a single scrape (profiling.summary() would build
+    # its own percentile summaries just to be thrown away here)
+    stats, gauges = profiling.stats_and_gauges()
+    lat_hists = profiling.latency_histograms()
+    entries = {label: ("stat", v) for label, v in stats.items()}
+    entries.update({label: ("lat", h) for label, h in lat_hists.items()})
+    entries.update({label: ("gauge", v) for label, v in gauges.items()})
     # family -> {"type": ..., "help": ..., "lines": [...]}
     families: Dict[str, Dict] = {}
 
@@ -168,13 +220,15 @@ def render_prometheus() -> str:
                                   "lines": []}
         return f["lines"]
 
-    for label, entry in sorted(snap.items()):
+    for label, (kind, value) in sorted(entries.items()):
         base, label_value = _family(label)
-        if "gauge" in entry:
+        if kind == "gauge":
             help_text = GAUGES.get(label, "unregistered gauge")
             fam(base, "gauge", help_text).append(
-                _series(base, label_value, entry["gauge"]))
-        elif "p50_ms" in entry:
+                _series(base, label_value, value))
+        elif kind == "lat":
+            h = value
+            entry = h.summary()
             help_text = LATENCIES.get(label, "latency reservoir")
             name = base + "_latency_seconds"
             lines = fam(name, "summary", help_text)
@@ -195,7 +249,22 @@ def render_prometheus() -> str:
             max_name = base + "_latency_max_seconds"
             fam(max_name, "gauge", help_text + " (max)").append(
                 _series(max_name, label_value, entry["max_ms"] / 1e3))
+            hist_name = base + "_latency_hist_seconds"
+            hlines = fam(hist_name, "histogram",
+                         help_text + " (mergeable log buckets)")
+            extra = ("" if label_value is None
+                     else f'label="{_escape(label_value)}",')
+            for le, cum in h.buckets():
+                hlines.append(
+                    f'{hist_name}_bucket{{{extra}le="{le:.9g}"}} {cum}')
+            hlines.append(
+                f'{hist_name}_bucket{{{extra}le="+Inf"}} {h.count}')
+            hlines.append(_series(hist_name + "_sum", label_value,
+                                  round(h.sum, 9)))
+            hlines.append(_series(hist_name + "_count", label_value,
+                                  h.count))
         else:  # stat accumulator: calls/total_s/max_s
+            entry = value
             help_text = STATS.get(label)
             if help_text is None and label_value is not None:
                 for prefix, (f_name, f_help) in DYNAMIC_PREFIXES.items():

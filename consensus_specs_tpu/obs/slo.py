@@ -1,0 +1,238 @@
+"""Declared SLOs + multi-window burn rates over the mergeable histograms.
+
+The serve and chain planes each declare a latency objective — "``q``% of
+requests complete under ``threshold``" — and this module turns the
+histogram bucket counts behind ``ops/profiling.record_latency`` into the
+two numbers an operator pages on:
+
+- **attainment**: the live ``q``-th percentile vs the threshold (is the
+  objective met RIGHT NOW), read by interpolation from the same fixed
+  log buckets every process shares;
+- **burn rate**: how fast the error budget is being consumed, per
+  lookback window. ``count_over(threshold)`` is exact bucket mass, so
+  ``bad_fraction / (1 - q/100)`` needs no sampling: burn 1.0 means the
+  budget is draining exactly at the sustainable rate, 10x means a page.
+  Two windows (fast + slow, the standard multi-window alert shape) keep
+  one spike from paging while a sustained burn still fires fast.
+
+Surfaces: ``slo.ok`` / ``slo.violations`` / ``slo.worst_burn_rate``
+gauges on ``/metrics``; the upgraded ``/healthz`` body (liveness AND
+objective state, obs/exposition.py); the ``slo`` section in the serve and
+head bench JSON lines, which ``tools/bench_compare.py`` gates round over
+round alongside throughput — a PR that regresses the tail past its
+objective fails CI like a throughput regression does.
+
+Objectives are env-tunable without code: ``CONSENSUS_SPECS_TPU_SLO`` is a
+comma list of ``key=value_ms`` overrides (``serve_p99_ms``,
+``chain_p99_ms``). Defaults are CPU-container-sized; an accelerator
+deployment tightens them by env.
+"""
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+SLO_ENV = "CONSENSUS_SPECS_TPU_SLO"
+
+# (name, latency label, quantile, default threshold ms) — the declared
+# objectives. Thresholds are deliberately loose for the 2-core CPU
+# container (a real deployment overrides by env): the stock serve bench
+# pays first-flush XLA compiles + an injected backend failure inside its
+# tail, measured ~12.5 s p99 cold — the default must hold THAT run green
+# so a violation means a regression, not a cold cache. What the gate
+# protects is the ROUND-OVER-ROUND objective state, not the absolute
+# number.
+_DEFAULTS: Tuple[Tuple[str, str, float, float], ...] = (
+    ("serve_p99", "serve.submit_to_result", 99.0, 30_000.0),
+    ("chain_p99", "chain.apply_batch", 99.0, 2_000.0),
+)
+
+# fast + slow burn windows (seconds): the classic multi-window pair,
+# container-scaled so a bench run spans several fast windows
+WINDOWS: Tuple[float, ...] = (60.0, 300.0)
+
+
+def _env_overrides() -> Dict[str, float]:
+    raw = os.environ.get(SLO_ENV, "")
+    out: Dict[str, float] = {}
+    for part in raw.split(","):
+        if "=" not in part:
+            continue
+        key, _, val = part.partition("=")
+        try:
+            out[key.strip()] = float(val)
+        except ValueError:
+            continue
+    return out
+
+
+def declared_objectives() -> List[Dict]:
+    """The objective list, env overrides applied (``<name>_ms=value``)."""
+    overrides = _env_overrides()
+    objectives = []
+    for name, label, quantile, default_ms in _DEFAULTS:
+        threshold_ms = overrides.get(f"{name}_ms", default_ms)
+        objectives.append({
+            "name": name,
+            "label": label,
+            "quantile": quantile,
+            "threshold_s": threshold_ms / 1e3,
+        })
+    return objectives
+
+
+class SloTracker:
+    """Burn-rate bookkeeping over the process's latency histograms.
+
+    Every ``evaluate()`` snapshots (count, count_over) per objective into
+    a bounded checkpoint ring (rate-limited to one checkpoint per second,
+    so a 10 Hz health prober cannot churn the 512-entry ring below the
+    slow window's span); a window's burn rate diffs the live counts
+    against the checkpoint CLOSEST to the window start (``now - w``) —
+    never a lifetime total, so one stale reading after an idle gap decays
+    as soon as fresher checkpoints exist. ``clock`` is injectable so
+    tests can march time deterministically.
+    """
+
+    # minimum seconds between stored checkpoints: 512 entries at this
+    # spacing span >= 512 s, comfortably past the 300 s slow window
+    _CHECKPOINT_SPACING = 1.0
+
+    def __init__(self, objectives: Optional[List[Dict]] = None,
+                 windows: Tuple[float, ...] = WINDOWS,
+                 clock=time.monotonic):
+        self._objectives = (objectives if objectives is not None
+                            else declared_objectives())
+        self._windows = tuple(windows)
+        self._clock = clock
+        self._lock = threading.Lock()
+        # (t, {objective name: (count, count_over)})
+        self._checkpoints: "deque[Tuple[float, Dict]]" = deque(maxlen=512)
+
+    # -- evaluation ----------------------------------------------------------
+
+    def evaluate(self) -> Dict[str, Dict]:
+        """Current objective state + burn rates; also records a checkpoint
+        and publishes the ``slo.*`` gauges."""
+        from ..ops import profiling
+
+        hists = profiling.latency_histograms()
+        now = self._clock()
+        counts: Dict[str, Tuple[int, int]] = {}
+        out: Dict[str, Dict] = {}
+        for obj in self._objectives:
+            h = hists.get(obj["label"])
+            n = h.count if h is not None else 0
+            over = h.count_over(obj["threshold_s"]) if h is not None else 0
+            counts[obj["name"]] = (n, over)
+            attained_s = (h.percentile(obj["quantile"])
+                          if h is not None and n else 0.0)
+            budget = max(1e-9, 1.0 - obj["quantile"] / 100.0)
+            entry = {
+                "label": obj["label"],
+                "objective_ms": round(obj["threshold_s"] * 1e3, 3),
+                "quantile": obj["quantile"],
+                "n": n,
+                "attained_ms": round(attained_s * 1e3, 3),
+                # vacuously met with no observations (a plane that never
+                # ran cannot violate its objective)
+                "ok": (n == 0) or attained_s <= obj["threshold_s"],
+                "bad_fraction": round(over / n, 6) if n else 0.0,
+            }
+            burn = {}
+            with self._lock:
+                for w in self._windows:
+                    # baseline: the checkpoint closest to the window start
+                    # (now - w) — the best available approximation of the
+                    # state w seconds ago. No checkpoints at all -> zero
+                    # burn (nothing to diff against), never a lifetime
+                    # total masquerading as a window.
+                    target = now - w
+                    base, best = None, None
+                    for t, snap in self._checkpoints:
+                        dist = abs(t - target)
+                        if best is None or dist < best:
+                            best, base = dist, snap.get(obj["name"], (0, 0))
+                    b_n, b_over = base if base is not None else (n, over)
+                    d_n, d_over = n - b_n, over - b_over
+                    rate = ((d_over / d_n) / budget) if d_n > 0 else 0.0
+                    burn[f"{w:g}s"] = round(rate, 4)
+            entry["burn_rate"] = burn
+            if n:
+                entry["margin"] = round(
+                    obj["threshold_s"] / max(attained_s, 1e-9), 4)
+            out[obj["name"]] = entry
+        with self._lock:
+            if (not self._checkpoints
+                    or now - self._checkpoints[-1][0]
+                    >= self._CHECKPOINT_SPACING):
+                self._checkpoints.append((now, counts))
+        self._export_gauges(out)
+        return out
+
+    def _export_gauges(self, evaluated: Dict[str, Dict]) -> None:
+        from ..ops import profiling
+
+        violations = sum(1 for e in evaluated.values() if not e["ok"])
+        worst = 0.0
+        for e in evaluated.values():
+            for rate in e["burn_rate"].values():
+                worst = max(worst, rate)
+        profiling.set_gauge("slo.ok", 0 if violations else 1)
+        profiling.set_gauge("slo.violations", violations)
+        profiling.set_gauge("slo.worst_burn_rate", worst)
+
+    # -- surfaces ------------------------------------------------------------
+
+    def healthz(self) -> Dict:
+        """The upgraded ``/healthz`` body: liveness + objective state."""
+        evaluated = self.evaluate()
+        return {
+            "ok": all(e["ok"] for e in evaluated.values()),
+            "slo": evaluated,
+        }
+
+    def bench_section(self) -> Dict[str, Dict]:
+        """The ``slo`` section of a bench JSON line — compact per-objective
+        state ``bench_compare`` can diff round over round (``margin`` is
+        the gated number: objective / attained, > 1 == meeting with room;
+        absent when the objective saw no traffic this run)."""
+        evaluated = self.evaluate()
+        section = {}
+        for name, e in evaluated.items():
+            row = {
+                "ok": bool(e["ok"]),
+                "n": e["n"],
+                "objective_ms": e["objective_ms"],
+                "attained_ms": e["attained_ms"],
+                "burn_rate": e["burn_rate"],
+            }
+            if "margin" in e:
+                row["margin"] = e["margin"]
+            section[name] = row
+        return section
+
+
+# -- process-global tracker ---------------------------------------------------
+
+_global_lock = threading.Lock()
+_global: Optional[SloTracker] = None
+
+
+def global_tracker() -> SloTracker:
+    """The process tracker (/healthz evaluates it on every probe; the
+    serve/head benches read their ``slo`` sections from it)."""
+    global _global
+    with _global_lock:
+        if _global is None:
+            _global = SloTracker()
+        return _global
+
+
+def reset_global() -> None:
+    """Fresh tracker + objectives (tests, multi-mode bench runs — also
+    re-reads the env overrides)."""
+    global _global
+    with _global_lock:
+        _global = None

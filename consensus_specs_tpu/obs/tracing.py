@@ -31,17 +31,21 @@ import time
 from collections import deque
 from typing import Dict, List, Optional, Tuple
 
+from . import registry as _registry
+
 TRACE_ENV = "CONSENSUS_SPECS_TPU_TRACE"
 
-# the five pipeline stages every traced request can carry (the acceptance
-# surface of the serve trace; `combine` only appears on RLC-routed flushes)
-STAGES = ("queue_wait", "prep", "device", "combine", "finalize")
-
-# the chain plane's per-gossip-batch stages (chain/head_service.py traces
-# one `chain_apply` record per batch: structural validation, the wait on
-# the verification service's batched signature verdicts, latest-message
-# application, and the proto-array's reverse sweep)
-CHAIN_STAGES = ("validate", "sig_wait", "apply", "sweep")
+# the span stages each plane stamps, re-exported from the canonical
+# registry (obs/registry.py SPAN_STAGES — the trace-coverage gate in
+# tests/test_obs.py asserts every registered stage appears in an exported
+# trace, so a new plane cannot silently ship untraced):
+# serve: the five per-request pipeline stages (`combine` only appears on
+# RLC-routed flushes); chain: the per-gossip-batch stages
+# (chain/head_service.py traces one `chain_apply` record per batch:
+# structural validation, the wait on the verification service's batched
+# signature verdicts, latest-message application, the reverse sweep)
+STAGES = _registry.SPAN_STAGES["serve"]
+CHAIN_STAGES = _registry.SPAN_STAGES["chain"]
 
 
 def trace_enabled() -> bool:
@@ -273,12 +277,10 @@ class Tracer:
         }
 
     def dump(self, path: str) -> str:
-        doc = self.to_chrome()
-        tmp = f"{path}.{os.getpid()}.tmp"
-        with open(tmp, "w") as fh:
-            json.dump(doc, fh, indent=1, sort_keys=True)
-        os.replace(tmp, path)
-        return path
+        from . import fsio
+
+        return fsio.atomic_write_text(
+            path, json.dumps(self.to_chrome(), indent=1, sort_keys=True))
 
 
 # -- process-global tracer ---------------------------------------------------
@@ -311,5 +313,31 @@ def reset_global() -> None:
 
 
 def dump_trace(path: str) -> str:
-    """Export the global tracer's rings as Chrome trace-event JSON."""
-    return global_tracer().dump(path)
+    """Export the global tracer's rings as Chrome trace-event JSON, with
+    the fleet lanes composed in: the per-device occupancy timeline
+    (obs/devices.py, pid 3) and the flight-recorder journal
+    (obs/flight.py, pid 4 instants) share the tracer's clock, so the span
+    view, the busy/idle view, and the black box line up on one timeline.
+    Disabled/empty lanes contribute nothing (``Tracer.dump`` alone stays
+    the lane-free export the golden test pins)."""
+    from . import devices, flight
+
+    tracer = global_tracer()
+    # epoch rewind for the composed lanes: a journal/occupancy event can
+    # predate the lazily-created tracer (e.g. a program resolution noted
+    # before the first traced execution) — same rule note_execution
+    # applies to its own early events, so no lane exports negative ts
+    earliest = min(
+        (t for t in (devices.earliest_timestamp(),
+                     flight.earliest_timestamp()) if t is not None),
+        default=None)
+    if earliest is not None:
+        with tracer._lock:
+            tracer._t0 = min(tracer._t0, earliest)
+    doc = tracer.to_chrome()
+    doc["traceEvents"].extend(devices.chrome_events(tracer._us))
+    doc["traceEvents"].extend(flight.chrome_events(tracer._us))
+    from . import fsio
+
+    return fsio.atomic_write_text(
+        path, json.dumps(doc, indent=1, sort_keys=True))

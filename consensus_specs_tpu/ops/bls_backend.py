@@ -253,15 +253,23 @@ def prune_vm_cache(max_age_days: float = None, max_bytes: int = None,
             total -= oldest[1]
             evict.append(oldest)
     evicted_bytes = 0
+    evicted_entries = 0
     for _, size, path in evict:
         try:
             os.remove(path)
             evicted_bytes += size
+            evicted_entries += 1
         except OSError:
             pass
+    # publish what the prune reclaimed (previously invisible: the only
+    # record was the returned dict the Make target printed and dropped)
+    from . import profiling
+
+    profiling.set_gauge("bls.vm_cache_pruned_entries", evicted_entries)
+    profiling.set_gauge("bls.vm_cache_pruned_bytes", evicted_bytes)
     return {
         "kept": len(entries),
-        "evicted": len(evict),
+        "evicted": evicted_entries,
         "kept_bytes": sum(size for _, size, _ in entries),
         "evicted_bytes": evicted_bytes,
     }
@@ -274,13 +282,26 @@ def _note_program(kind: str, k: int, fold: int, assembled, seconds: float,
     Called once per (kind, k, fold) per process (the lru_cache on
     _program absorbs repeats); never allowed to break program resolution."""
     try:
-        from ..obs import programs as obs_programs
+        from ..obs import flight, programs as obs_programs
 
+        key = f"{kind}[k={k},fold={fold}]"
         obs_programs.note_assembly(
-            f"{kind}[k={k},fold={fold}]",
+            key,
             n_steps=assembled.n_steps, n_regs=assembled.n_regs,
             seconds=seconds, disk_cache_hit=disk_hit,
         )
+        # flight journal: program resolutions are the "why was this run
+        # slow" forensic — a .vm_cache miss means seconds-scale list
+        # scheduling was paid inline (an assembly STALL when it crossed
+        # one second, the threshold the measured ~250k ops/sec scheduler
+        # makes meaningful)
+        flight.note("vm", "program_resolved", key=key,
+                    cache="hit" if disk_hit else "miss",
+                    seconds=round(seconds, 4))
+        if not disk_hit and seconds >= 1.0:
+            flight.note("vm", "assembly_stall", key=key,
+                        seconds=round(seconds, 4),
+                        steps=int(assembled.n_steps))
     except Exception:
         pass
 

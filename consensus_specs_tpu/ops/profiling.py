@@ -4,26 +4,36 @@ per-kernel timing and an XLA trace hook).
 
 - ``record(...)`` is called by vm.execute around every device program run;
   stats accumulate per (program kind, batch shape) in-process.
-- ``record_latency(...)`` feeds a bounded-reservoir percentile tracker —
-  mean/max cannot express a serving SLO, so the serve plane's
-  submit->result latencies report p50/p95/p99 (nearest-rank over an
-  Algorithm-R reservoir; deterministic seed so reruns are comparable).
+- ``record_latency(...)`` feeds a mergeable log-bucketed histogram
+  (``obs/hist.py``: fixed base-2/8-subbucket bounds, so histograms from
+  different devices/nodes/processes aggregate EXACTLY — the Algorithm-R
+  reservoir this replaced could not be combined across a fleet).
+  Percentile reads interpolate inside the crossing bucket and agree with
+  the exact nearest-rank statistic within one bucket width (~9%); the
+  published ``p50/p95/p99`` family names are unchanged, and every family
+  now carries ``n`` (observation count) so consumers can judge
+  statistical weight.
 - ``set_gauge(...)`` publishes point-in-time values (queue depth, cache
   hit rate, batch occupancy) from the serve plane.
-- ``summary()``/``report()`` expose all three; bench.py attaches the
-  summary to its JSON line when CONSENSUS_SPECS_TPU_PROFILE=1 (the serve
-  bench mode attaches it always).
+- ``summary()``/``snapshot()``/``report()`` expose all three; bench.py
+  attaches the summary to its JSON line when CONSENSUS_SPECS_TPU_PROFILE=1
+  (the serve bench mode attaches it always).
+- ``latency_histograms()`` hands detached histogram copies to the
+  Prometheus renderer (full ``_bucket``/``_sum``/``_count`` exposition)
+  and the SLO burn-rate tracker (``obs/slo.py``).
 - ``trace(path)`` wraps a block in jax.profiler's trace for TensorBoard /
   xprof when deeper inspection is wanted (no-op if the profiler is
   unavailable on the platform).
 """
 import contextlib
 import os
-import random
 import threading
 import time
 from collections import defaultdict
 from typing import Dict, List
+
+from ..obs import hist
+
 
 def enabled() -> bool:
     """Whether CONSENSUS_SPECS_TPU_PROFILE=1 — re-read on EVERY call, so
@@ -31,9 +41,6 @@ def enabled() -> bool:
     REPL) takes effect immediately. The historical module-level ``ENABLED``
     read stays correct through the dynamic alias below."""
     return os.environ.get("CONSENSUS_SPECS_TPU_PROFILE") == "1"
-
-
-_RESERVOIR_SEED = 0x5EED
 
 
 def __getattr__(name: str):
@@ -47,12 +54,11 @@ _stats: Dict[str, Dict[str, float]] = defaultdict(
     lambda: {"calls": 0, "total_s": 0.0, "max_s": 0.0}
 )
 
-RESERVOIR_CAP = 4096
+# count of live latency-histogram families, published as a gauge so a
+# scrape shows how many distributions the process tracks (drift-gated)
+HIST_FAMILIES_LABEL = "hist.families"
 
-_lat: Dict[str, Dict] = defaultdict(
-    lambda: {"count": 0, "total_s": 0.0, "max_s": 0.0, "sample": []}
-)
-_lat_rng = random.Random(_RESERVOIR_SEED)  # deterministic: reruns sample identically
+_lat: Dict[str, hist.Histogram] = {}
 # one lock for every accumulator: the serve plane writes timings, gauges
 # AND latencies concurrently from submit threads and its worker, so an
 # unlocked summary() could see a dict resize mid-iteration
@@ -69,25 +75,20 @@ def record(label: str, seconds: float) -> None:
 
 
 def record_latency(label: str, seconds: float) -> None:
-    """Feed one latency observation into ``label``'s bounded reservoir
-    (Algorithm R: every observation has equal probability of being in the
-    sample, so percentiles stay unbiased at any stream length)."""
+    """Feed one latency observation into ``label``'s mergeable histogram
+    (fixed log buckets: observations land in the same bucket on every
+    device/node, so fleet aggregation is exact addition)."""
     with _lock:
-        s = _lat[label]
-        s["count"] += 1
-        s["total_s"] += seconds
-        s["max_s"] = max(s["max_s"], seconds)
-        sample: List[float] = s["sample"]
-        if len(sample) < RESERVOIR_CAP:
-            sample.append(seconds)
-        else:
-            j = _lat_rng.randrange(s["count"])
-            if j < RESERVOIR_CAP:
-                sample[j] = seconds
+        h = _lat.get(label)
+        if h is None:
+            h = _lat[label] = hist.Histogram()
+            _gauges[HIST_FAMILIES_LABEL] = float(len(_lat))
+    h.observe(seconds)
 
 
 def _percentile(sorted_sample: List[float], q: float) -> float:
-    """Nearest-rank percentile over an ascending sample."""
+    """Nearest-rank percentile over an ascending sample (the exact
+    statistic the histogram is gated against in tests)."""
     if not sorted_sample:
         return 0.0
     rank = max(1, -(-int(q * len(sorted_sample)) // 100))  # ceil(q*n/100)
@@ -95,21 +96,26 @@ def _percentile(sorted_sample: List[float], q: float) -> float:
     return sorted_sample[rank - 1]
 
 
+def stats_and_gauges():
+    """One-lock copies of the stat accumulators and gauges — the
+    Prometheus renderer reads these alongside ``latency_histograms()``
+    instead of paying ``summary()``'s full percentile build per scrape."""
+    with _lock:
+        return ({k: dict(v) for k, v in _stats.items()}, dict(_gauges))
+
+
+def latency_histograms() -> Dict[str, hist.Histogram]:
+    """Detached histogram copies per label (Prometheus ``_bucket``
+    rendering, SLO burn rates, fleet merges)."""
+    with _lock:
+        snap = dict(_lat)
+    return {label: h.snapshot() for label, h in sorted(snap.items())}
+
+
 def latency_summary() -> Dict[str, Dict[str, float]]:
     out = {}
-    with _lock:
-        snap = {label: (s["count"], s["total_s"], s["max_s"], list(s["sample"]))
-                for label, s in _lat.items()}
-    for label, (count, total_s, max_s, raw) in sorted(snap.items()):
-        sample = sorted(raw)
-        out[label] = {
-            "count": int(count),
-            "mean_ms": round(total_s / max(1, count) * 1e3, 3),
-            "p50_ms": round(_percentile(sample, 50) * 1e3, 3),
-            "p95_ms": round(_percentile(sample, 95) * 1e3, 3),
-            "p99_ms": round(_percentile(sample, 99) * 1e3, 3),
-            "max_ms": round(max_s * 1e3, 3),
-        }
+    for label, h in latency_histograms().items():
+        out[label] = h.summary()
     return out
 
 
@@ -146,17 +152,24 @@ def summary() -> Dict[str, Dict[str, float]]:
     return out
 
 
+def snapshot() -> Dict[str, Dict[str, float]]:
+    """Alias of ``summary()`` under the fleet naming: every percentile
+    family in it carries ``n`` (= observation count) alongside the
+    p50/p95/p99 points, so any consumer of the snapshot can weigh a
+    percentile by how many observations back it."""
+    return summary()
+
+
 def reset() -> None:
     """Clear ALL THREE accumulator families — per-label stats, latency
-    reservoirs, gauges — and re-seed the reservoir RNG, so a post-reset
-    run is indistinguishable from a fresh process (multi-mode bench runs
-    reset between modes; determinism is part of the reruns-are-comparable
-    contract)."""
+    histograms, gauges — so a post-reset run is indistinguishable from a
+    fresh process (multi-mode bench runs reset between modes; histogram
+    bucketing is deterministic, so reruns are comparable by
+    construction)."""
     with _lock:
         _stats.clear()
         _lat.clear()
         _gauges.clear()
-        _lat_rng.seed(_RESERVOIR_SEED)
 
 
 def report() -> str:

@@ -632,6 +632,16 @@ def execute(program: Program, inputs: Dict[str, np.ndarray], batch_shape=(),
             batch=tuple(batch_shape), sharded=mesh is not None,
             t0=t0, seconds=dt,
         )
+    # per-device occupancy ledger (obs/devices.py): this execution kept
+    # every participating device busy for dt — the utilization numbers
+    # ROADMAP item 1's shard_map tuning reads. Same cost profile as the
+    # trace hook above: one None check when disabled, device-call scale.
+    from ..obs import devices
+
+    ledger = devices.maybe_ledger()
+    if ledger is not None:
+        ledger.note_execution(mesh, t0, dt,
+                              label=f"vm[steps={program.n_steps}]")
     out = np.asarray(out)
     return {
         name: out[..., i, :]

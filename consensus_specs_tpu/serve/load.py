@@ -173,13 +173,21 @@ def run_serve_bench(target: float = TARGET_PER_CHIP) -> dict:
     from .service import VerificationService
 
     # clean slate: the serve line always attaches profiling.summary(), and
-    # a prior mode's reservoirs/gauges in this process (multi-mode bench
+    # a prior mode's histograms/gauges in this process (multi-mode bench
     # runs, tests) must not bleed into it; the once-per-process vm-cache
-    # gauges are re-published after the wipe
-    from ..obs import programs as obs_programs
+    # gauges are re-published after the wipe. The device ledger and SLO
+    # tracker reset too — utilization denominators and burn windows start
+    # at THIS run
+    from ..obs import devices, programs as obs_programs, slo
 
     profiling.reset()
     obs_programs.export_gauges()
+    devices.reset_global()
+    slo.reset_global()
+    # baseline checkpoint at run start: the end-of-run slo section's burn
+    # windows then measure THIS run's error mass (one evaluate() with an
+    # empty ring would otherwise diff against itself — zero burn forever)
+    slo.global_tracker().evaluate()
 
     # rate sized so a max_wait flush window catches several events (~4 ms
     # apart at 256 Hz): micro-batches then carry >1 unique committee and
@@ -293,6 +301,17 @@ def run_serve_bench(target: float = TARGET_PER_CHIP) -> dict:
         )
 
     snap = svc.metrics.snapshot()
+    # fleet-observability sections (ISSUE 7), evaluated BEFORE the profile
+    # snapshot so the device[*]/slo.* gauges they publish ride the
+    # attached profiling.summary() too: per-device occupancy from the
+    # ledger this run's vm.execute calls fed, and the SLO state the
+    # round-over-round gate (tools/bench_compare.py) diffs
+    ledger = devices.maybe_ledger()
+    devices_section = None
+    if ledger is not None:
+        ledger.export_gauges()
+        devices_section = ledger.snapshot()
+    slo_section = slo.global_tracker().bench_section()
     # SERVED vs VERIFIED: the duplicate-heavy stream is answered mostly by
     # the cache/dedup layer, so served/sec is the serving-plane headline
     # while verified/sec (unique content that actually reached crypto) is
@@ -321,6 +340,8 @@ def run_serve_bench(target: float = TARGET_PER_CHIP) -> dict:
         p50_ms=snap["latency"].get("p50_ms", 0.0),
         p95_ms=snap["latency"].get("p95_ms", 0.0),
         p99_ms=snap["latency"].get("p99_ms", 0.0),
+        # observation count behind the percentiles (statistical weight)
+        latency_n=snap["latency"].get("n", 0),
         batches=snap["batches"],
         # prep-vs-device split: where each flush's time goes (host codec
         # prep of the NEXT batch overlaps the device hard part, so the
@@ -341,8 +362,11 @@ def run_serve_bench(target: float = TARGET_PER_CHIP) -> dict:
         fault_injected=bool(inject and getattr(backend, "fired", 0)),
         lost=lost,
         wrong=wrong,
+        slo=slo_section,
         profile=profiling.summary(),
     )
+    if devices_section is not None:
+        result["devices"] = devices_section
     if exposition is not None:
         result["metrics_port"] = exposition.port
         result["metrics_scrape_ok"] = scrape is not None

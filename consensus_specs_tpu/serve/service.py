@@ -51,7 +51,7 @@ from collections import deque
 from concurrent.futures import Future
 from typing import List, Optional
 
-from ..obs import tracing
+from ..obs import devices, flight, tracing
 from ..ops import profiling
 from .cache import ResultCache, check_key
 from .metrics import ServeMetrics
@@ -128,6 +128,12 @@ class VerificationService:
         # is set AT CONSTRUCTION. Disabled == None: every stage guards on
         # one `is not None` — no new locks or allocations on the hot path.
         self._tracer = tracer if tracer is not None else tracing.maybe_tracer()
+        # flight recorder + device-occupancy ledger (obs/flight.py,
+        # obs/devices.py), captured at construction exactly like the
+        # tracer: disabled == None, every site guards on `is not None` —
+        # no locks or env reads join the hot path when off
+        self._flight = flight.maybe_recorder()
+        self._devices = devices.maybe_ledger()
         if oracle is None:
             from ..utils import bls
 
@@ -225,12 +231,18 @@ class VerificationService:
                 if hit is not None:
                     self.metrics.note_cache_hit()
                     self.metrics.note_result(time.perf_counter() - t0)
+                    if self._flight is not None:
+                        self._flight.note("serve", "cache_hit",
+                                          check_kind=kind)
                     fut.set_result(hit)
                     return fut
                 pend = self._inflight.get(key)
                 if pend is not None:
                     # same content already queued/verifying: share its Future
                     self.metrics.note_inflight_join()
+                    if self._flight is not None:
+                        self._flight.note("serve", "dedup_join",
+                                          check_kind=kind)
                     return pend.future
                 if len(self._queue) + self._staged < self._max_queue:
                     break
@@ -307,8 +319,18 @@ class VerificationService:
                 # stage's per-item cache misses re-derive (and re-raise)
                 # whatever prep could not produce
                 profiling.record("serve.prep_error", 0.0)
+                if self._flight is not None:
+                    self._flight.note("serve", "prep_error",
+                                      items=len(batch))
             t1 = time.perf_counter()
             self.metrics.note_prep(t1 - t0)
+            if self._devices is not None:
+                # the prep stage's host-codec time on the dedicated host
+                # lane: the occupancy timeline then shows the pipeline
+                # overlap (host busy on batch N+1 while a device lane is
+                # busy on batch N)
+                self._devices.note_busy(devices.HOST_LANE, t0, t1,
+                                        label="prep")
             if self._tracer is not None:
                 self._tracer.span_many((p.trace for p in batch), "prep",
                                        t0, t1)
@@ -345,10 +367,15 @@ class VerificationService:
                 self._not_full.notify_all()
             try:
                 self._process(batch)
-            except Exception:
+            except Exception as e:
                 # belt-and-braces: _process guards each group; whatever
                 # still leaks must not kill the stream — resolve the
                 # batch through the oracle, item by item
+                if self._flight is not None:
+                    self._flight.note("serve", "device_stage_error",
+                                      items=len(batch),
+                                      error=f"{type(e).__name__}: {e}"[:200])
+                    self._flight.dump_on_fault("serve_device_stage_error")
                 self._resolve_sequential(
                     [p for p in batch if not p.future.done()]
                 )
@@ -384,6 +411,9 @@ class VerificationService:
         groups = {}
         for p in batch:
             groups.setdefault((p.kind, p.bucket), []).append(p)
+        if self._flight is not None:
+            self._flight.note("serve", "flush", items=len(batch),
+                              groups=len(groups))
         t_flush = time.perf_counter()
         results = self._verify_rlc(batch)
         if results is not None:
@@ -435,6 +465,10 @@ class VerificationService:
         for attempt in range(1 + self._backend_retries):
             if attempt:
                 self.metrics.note_retry()
+                if self._flight is not None:
+                    self._flight.note("serve", "backend_retry",
+                                      stage="rlc", attempt=attempt,
+                                      items=len(batch))
             try:
                 t0 = time.perf_counter()
                 res = [bool(r) for r in rlc_fn(items)]
@@ -448,6 +482,12 @@ class VerificationService:
             except Exception:
                 pass
         profiling.record("serve.rlc_error", 0.0)
+        if self._flight is not None:
+            # degradation-ladder rung 1: the whole-flush RLC combine gave
+            # up; the per-group path (its own retry-then-oracle ladder)
+            # takes over
+            self._flight.note("serve", "degraded_rlc_to_groups",
+                              items=len(batch))
         return None
 
     def _verify_group(self, kind: str, pends: List[_Pending]) -> List[bool]:
@@ -456,6 +496,10 @@ class VerificationService:
         for attempt in range(1 + self._backend_retries):
             if attempt:
                 self.metrics.note_retry()
+                if self._flight is not None:
+                    self._flight.note("serve", "backend_retry",
+                                      stage="group", attempt=attempt,
+                                      check_kind=kind, items=len(pends))
             try:
                 if kind == "fast_aggregate":
                     res = backend.batch_fast_aggregate_verify(
@@ -475,6 +519,16 @@ class VerificationService:
         # poisoned batch: degrade to sequential oracle verification —
         # the stream slows down, it does not fail
         profiling.record("serve.backend_error", 0.0)
+        if self._flight is not None:
+            # degradation-ladder rung 2 (the bottom): this is the fault a
+            # post-mortem wants — journal the transition, then auto-dump
+            # so the sequence of events that led here survives the run
+            self._flight.note(
+                "serve", "degraded_to_oracle", check_kind=kind,
+                items=len(pends),
+                error=(f"{type(last_err).__name__}: {last_err}"[:200]
+                       if last_err is not None else None))
+            self._flight.dump_on_fault("serve_backend_degraded_to_oracle")
         del last_err
         self.metrics.note_fallback(len(pends))
         return [self._oracle_one(p) for p in pends]

@@ -152,6 +152,109 @@ def test_round_ordering_is_numeric_not_lexical(tmp_path, bc):
     assert bc.main(["--dir", str(tmp_path)]) == 1  # r10 regressed vs r02
 
 
+def _slo_parsed(value, margin, ok, n=100, **extra):
+    return _parsed(value, mode="serve", n=None, k=None,
+                   slo={"serve_p99": {"ok": ok, "n": n, "margin": margin,
+                                      "objective_ms": 5000.0,
+                                      "attained_ms": 5000.0 / margin,
+                                      "burn_rate": {"60s": 0.0}}},
+                   **extra)
+
+
+def test_slo_newly_violated_objective_fails(tmp_path, bc, capsys):
+    """The SLO gate (ISSUE 7): a previously-met objective the newest
+    round violates fails outright, even though throughput stayed flat."""
+    _write_round(tmp_path, 1, _slo_parsed(300.0, margin=2.5, ok=True))
+    _write_round(tmp_path, 2, _slo_parsed(300.0, margin=0.8, ok=False))
+    assert bc.main(["--dir", str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "cpu:slo:serve_p99" in out and "SLO VIOLATED" in out
+
+
+def test_slo_margin_jitter_within_met_never_fails(tmp_path, bc, capsys):
+    """Tail latencies flap far more than throughput: a big margin drop
+    that still MEETS the objective is reported, not failed."""
+    _write_round(tmp_path, 1, _slo_parsed(300.0, margin=9.0, ok=True))
+    _write_round(tmp_path, 2, _slo_parsed(300.0, margin=1.4, ok=True))
+    assert bc.main(["--dir", str(tmp_path)]) == 0
+    assert "cpu:slo:serve_p99" in capsys.readouterr().out
+
+
+def test_slo_still_violated_is_not_a_new_failure(tmp_path, bc):
+    """ok False -> False: already red last round; the throughput gate
+    still decides (a permanently-red objective must not wedge every
+    future round — the VIOLATION round already failed once)."""
+    _write_round(tmp_path, 1, _slo_parsed(300.0, margin=0.7, ok=False))
+    _write_round(tmp_path, 2, _slo_parsed(300.0, margin=0.6, ok=False))
+    assert bc.main(["--dir", str(tmp_path)]) == 0
+
+
+def test_slo_objectives_without_traffic_are_skipped(tmp_path, bc):
+    quiet = _parsed(300.0, mode="serve", n=None, k=None,
+                    slo={"chain_p99": {"ok": True, "n": 0,
+                                       "objective_ms": 2000.0,
+                                       "attained_ms": 0.0,
+                                       "burn_rate": {}}})
+    assert bc.extract_slo({"parsed": quiet}) == {}
+
+
+def test_slo_gate_reached_without_common_throughput_keys(tmp_path, bc,
+                                                         capsys):
+    """Shared SLO keys are comparables in their own right: two rounds
+    with disjoint throughput shapes (say the head bench changed tree
+    sizes) but the same declared objective must still gate a
+    met -> violated transition instead of skipping."""
+    _write_round(tmp_path, 1, _parsed(
+        1000.0, mode="head", n=None, k=None, blocks=1024,
+        slo={"chain_p99": {"ok": True, "n": 50, "margin": 3.0,
+                           "objective_ms": 2000.0, "attained_ms": 666.0,
+                           "burn_rate": {}}}))
+    _write_round(tmp_path, 2, _parsed(
+        900.0, mode="head", n=None, k=None, blocks=128,  # disjoint shape
+        slo={"chain_p99": {"ok": False, "n": 50, "margin": 0.5,
+                           "objective_ms": 2000.0, "attained_ms": 4000.0,
+                           "burn_rate": {}}}))
+    assert bc.main(["--dir", str(tmp_path)]) == 1
+    assert "SLO VIOLATED" in capsys.readouterr().out
+
+
+def test_slo_only_previous_round_is_a_usable_baseline(tmp_path, bc,
+                                                      capsys):
+    """A prior round whose headline value is unusable (<=0) but whose slo
+    section recorded objective state still baselines the SLO gate — the
+    walk must not skip past it to 'no earlier round'."""
+    broken_headline = _slo_parsed(300.0, margin=2.0, ok=True)
+    broken_headline["value"] = 0.0  # headline unusable, slo intact
+    _write_round(tmp_path, 1, broken_headline)
+    _write_round(tmp_path, 2, _slo_parsed(300.0, margin=0.5, ok=False))
+    assert bc.main(["--dir", str(tmp_path)]) == 1
+    assert "SLO VIOLATED" in capsys.readouterr().out
+
+
+def test_markdown_table_written_to_github_step_summary(tmp_path, bc,
+                                                      monkeypatch):
+    summary_file = tmp_path / "summary.md"
+    monkeypatch.setenv("GITHUB_STEP_SUMMARY", str(summary_file))
+    _write_round(tmp_path, 1, _slo_parsed(300.0, margin=2.0, ok=True))
+    _write_round(tmp_path, 2, _slo_parsed(280.0, margin=1.8, ok=True))
+    assert bc.main(["--dir", str(tmp_path)]) == 0
+    body = summary_file.read_text()
+    assert "| key | previous | newest | delta | status |" in body
+    assert "`cpu:serve`" in body and "`cpu:slo:serve_p99`" in body
+    assert "-6.7%" in body
+
+
+def test_markdown_table_falls_back_to_stdout(tmp_path, bc, monkeypatch,
+                                             capsys):
+    monkeypatch.delenv("GITHUB_STEP_SUMMARY", raising=False)
+    _write_round(tmp_path, 1, _parsed(300.0))
+    _write_round(tmp_path, 2, _parsed(280.0))
+    assert bc.main(["--dir", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "| key | previous | newest | delta | status |" in out
+    assert "| `cpu:committee[32x128]` |" in out
+
+
 def test_real_repo_rounds_pass(bc, monkeypatch):
     """The committed BENCH_r*.json history must satisfy its own gate at
     the DEFAULT threshold (this is the `make bench-compare` invocation CI

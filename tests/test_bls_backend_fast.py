@@ -151,6 +151,13 @@ def test_prune_vm_cache_evicts_by_idle_age_and_size(tmp_path):
     assert out["evicted"] == 2 and out["kept"] == 2
     left = sorted(os.listdir(d))
     assert left == ["README.txt", "v1_bbbb_new1.pkl", "v1_bbbb_new2.pkl"]
+    # the prune publishes what it reclaimed through the registry (ISSUE 7
+    # satellite: previously the returned dict was the only record)
+    from consensus_specs_tpu.ops import profiling
+
+    summ = profiling.summary()
+    assert summ["bls.vm_cache_pruned_entries"] == {"gauge": 2.0}
+    assert summ["bls.vm_cache_pruned_bytes"] == {"gauge": 2000.0}
 
     # size cap: keep only the newest entry's bytes
     out = prune_vm_cache(max_age_days=0, max_bytes=1000, cache_dir=d)

@@ -74,7 +74,9 @@ def test_emitted_labels_were_actually_found():
                      "chain.apply_batch", "chain.head_changes",
                      "chain.reorgs", "chain.dropped_attestations",
                      "vm.analysis_programs", "vm.analysis_errors",
-                     "vm.analysis_hazards", "vm.analysis_max_live"):
+                     "vm.analysis_hazards", "vm.analysis_max_live",
+                     "hist.families", "device.count", "flight.events",
+                     "slo.ok", "bls.vm_cache_pruned_bytes"):
         assert expected in found, f"label scan lost {expected}"
 
 
@@ -105,6 +107,37 @@ def test_chain_gauge_family_is_complete():
         f"chain gauge drift: declared-not-registered={declared - registered}, "
         f"registered-not-declared={registered - declared}"
     )
+
+
+def test_fleet_gauge_families_are_complete():
+    # the PR 7 families (mergeable histograms, device ledger, flight
+    # recorder, SLO tracker): every emitted static label is registered
+    # AND every registered label has an emission site — a rename in
+    # either direction fails here instead of orphaning a scrape rule
+    emitted = _emitted_labels()
+    for prefix in ("hist.", "device.", "flight.", "slo."):
+        family_emitted = {l for l in emitted if l.startswith(prefix)}
+        family_registered = {n for n in registry.GAUGES
+                             if n.startswith(prefix)}
+        assert family_emitted == family_registered, (
+            f"{prefix}* gauge drift: emitted-not-registered="
+            f"{family_emitted - family_registered}, "
+            f"registered-not-emitted={family_registered - family_emitted}"
+        )
+    # the dynamic per-device family has a real emission site
+    dev_src = open(os.path.join(_PKG, "obs", "devices.py")).read()
+    assert 'f"device[{lane}]"' in dev_src
+    assert "device[" in registry.DYNAMIC_PREFIXES
+
+
+def test_span_stage_registry_matches_tracing_exports():
+    # obs/registry.SPAN_STAGES is the canonical stage list; tracing
+    # re-exports it — the coverage gate in tests/test_obs.py holds every
+    # registered stage to an actual trace export
+    from consensus_specs_tpu.obs import tracing
+
+    assert tracing.STAGES == registry.SPAN_STAGES["serve"]
+    assert tracing.CHAIN_STAGES == registry.SPAN_STAGES["chain"]
 
 
 def test_registry_names_are_documented():

@@ -19,10 +19,11 @@ import urllib.request
 
 import pytest
 
+from consensus_specs_tpu.obs import devices, flight, slo
 from consensus_specs_tpu.obs import programs as obs_programs
 from consensus_specs_tpu.obs import registry, tracing
 from consensus_specs_tpu.obs.exposition import start_exposition
-from consensus_specs_tpu.obs.tracing import STAGES, Tracer
+from consensus_specs_tpu.obs.tracing import CHAIN_STAGES, STAGES, Tracer
 from consensus_specs_tpu.ops import profiling
 from consensus_specs_tpu.serve import VerificationService
 from consensus_specs_tpu.serve.metrics import ServeMetrics
@@ -38,14 +39,22 @@ def _clean_slate(monkeypatch):
     # the obs plane and profiling are process-global; every test starts
     # from zero and leaves tracing disabled
     monkeypatch.setenv("CONSENSUS_SPECS_TPU_TRACE", "0")
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_FLIGHT", "0")
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_DEVICES", "0")
     profiling.reset()
     tracing.reset_global()
     obs_programs.reset()
+    devices.reset_global()
+    flight.reset_global()
+    slo.reset_global()
     was = bls.bls_active
     bls.bls_active = True
     yield
     bls.bls_active = was
     tracing.reset_global()
+    devices.reset_global()
+    flight.reset_global()
+    slo.reset_global()
 
 
 class RlcBackend:
@@ -166,6 +175,15 @@ def _golden_tracer():
     tr.finish(req, True, t_done=0.010)
     tr.note_execution(steps=256, regs=640, batch=(4,), sharded=False,
                       t0=0.005, seconds=0.003)
+    # one chain-plane batch record (PR 5's validate/sig_wait/apply/sweep
+    # stages — part of the golden schema since PR 7 so the trace-coverage
+    # gate below can hold every registered stage to an export)
+    chain = tr.begin("chain_apply", 3, t_submit=0.011)
+    tr.span(chain, "validate", 0.011, 0.012)
+    tr.span(chain, "sig_wait", 0.012, 0.014)
+    tr.span(chain, "apply", 0.014, 0.015)
+    tr.span(chain, "sweep", 0.015, 0.016)
+    tr.finish(chain, True, t_done=0.016)
     obs_programs.note_assembly("hard_part[k=0,fold=32]", n_steps=4864,
                                n_regs=1024, seconds=1.5,
                                disk_cache_hit=False)
@@ -187,13 +205,36 @@ def test_chrome_export_schema():
             assert ev["ts"] >= 0 and ev["dur"] >= 0
             assert isinstance(ev["tid"], int)
             names.add(ev["name"])
-    # all five pipeline stages + the VM execution row made it out
+    # all five pipeline stages + the chain batch stages + the VM
+    # execution row made it out
     assert set(STAGES) <= names
+    assert set(CHAIN_STAGES) <= names
     assert any(n.startswith("vm[steps=256") for n in names)
     reg = doc["programRegistry"]
     assert reg["vm_cache"] == {"disk_hits": 1, "disk_misses": 1}
     assert reg["programs"]["hard_part[k=0,fold=32]"]["vm_cache"] == "miss"
     assert reg["programs"]["hard_part[k=0,fold=32]"]["assembly_s"] == 1.5
+
+
+def test_every_registered_span_stage_is_exported():
+    """The trace-coverage gate (ISSUE 7 satellite): every span stage any
+    plane registers in ``obs/registry.SPAN_STAGES`` must appear in the
+    golden tracer's Chrome export — a plane that registers stages but
+    never exports them (or registers a stage the tracing plane dropped)
+    fails HERE, so future planes cannot silently ship untraced."""
+    doc = _golden_tracer().to_chrome()
+    exported = {ev["name"] for ev in doc["traceEvents"] if ev["ph"] == "X"}
+    for plane, stages in registry.SPAN_STAGES.items():
+        missing = set(stages) - exported
+        assert not missing, (
+            f"plane {plane!r} registers span stages that no exported "
+            f"trace carries: {sorted(missing)} — extend _golden_tracer() "
+            "with the new plane's spans (and regen the golden) so the "
+            "coverage gate holds it to an export"
+        )
+    # the re-exported tuples stay in lockstep with the registry
+    assert STAGES == registry.SPAN_STAGES["serve"]
+    assert CHAIN_STAGES == registry.SPAN_STAGES["chain"]
 
 
 def test_chrome_export_matches_golden(tmp_path):
@@ -331,7 +372,12 @@ def test_exposition_scrapeable_under_load():
         snap = json.loads(body)
         assert status == 200 and snap["submits"] > 0
         status, body = _get(server.url("/healthz"))
-        assert status == 200 and json.loads(body) == {"ok": True}
+        health = json.loads(body)
+        # the PR 7 /healthz upgrade: liveness + SLO state in one body
+        assert status == 200 and health["ok"] is True
+        assert set(health["slo"]) == {"serve_p99", "chain_p99"}
+        serve_slo = health["slo"]["serve_p99"]
+        assert serve_slo["n"] > 0 and serve_slo["ok"] is True
         with pytest.raises(urllib.error.HTTPError):
             _get(server.url("/nope"))
     finally:
@@ -482,25 +528,40 @@ def test_profiling_reset_clears_all_three_families():
     profiling.record("x.stat", 1.0)
     profiling.record_latency("x.lat", 0.5)
     profiling.set_gauge("x.gauge", 2.0)
-    assert len(profiling.summary()) == 3
+    summ = profiling.summary()
+    # the three recorded families + the hist.families tracking gauge
+    assert {"x.stat", "x.lat", "x.gauge"} <= set(summ)
+    assert summ["hist.families"] == {"gauge": 1.0}
     profiling.reset()
     assert profiling.summary() == {}
     assert profiling.latency_summary() == {}
 
 
-def test_profiling_reset_reseeds_reservoir_deterministically():
-    """Post-reset reservoir sampling must be identical to a fresh process:
-    overflow the reservoir twice with the same stream and require the
-    exact same retained sample (the reruns-are-comparable contract)."""
+def test_profiling_post_reset_runs_match_fresh_process():
+    """Post-reset latency accounting must be identical to a fresh
+    process: replay the same stream twice across a reset and require the
+    exact same summary (the reruns-are-comparable contract — trivially
+    deterministic now that fixed-bucket histograms replaced the sampled
+    reservoir, and pinned here so a future implementation keeps it)."""
 
     def fill():
         profiling.reset()
         rng = random.Random(1)
-        for _ in range(profiling.RESERVOIR_CAP + 512):
+        for _ in range(4096 + 512):
             profiling.record_latency("l", rng.random())
         return profiling.latency_summary()["l"]
 
     assert fill() == fill()
+
+
+def test_profiling_snapshot_carries_observation_counts():
+    """Every percentile family exposes ``n`` next to the p50/p95/p99
+    points (ISSUE 7 satellite: consumers judge statistical weight)."""
+    for _ in range(37):
+        profiling.record_latency("serve.submit_to_result", 0.01)
+    fam = profiling.snapshot()["serve.submit_to_result"]
+    assert fam["n"] == 37 and fam["count"] == 37
+    assert {"p50_ms", "p95_ms", "p99_ms"} <= set(fam)
 
 
 # -- bench --trace glue -----------------------------------------------------

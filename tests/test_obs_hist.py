@@ -1,0 +1,231 @@
+"""Mergeable log-bucketed histograms (obs/hist.py): fixed-bound bucket
+determinism, exact merge algebra (commutative + associative, split-feed ==
+single-feed), percentile agreement with the exact nearest-rank statistic
+the replaced reservoir computed (within one bucket width — the ISSUE 7
+acceptance bar), the Prometheus ``_bucket``/``_sum``/``_count``
+exposition, and the writers-vs-readers concurrency hammer over live
+merge + scrape.
+"""
+import random
+import re
+import threading
+
+import pytest
+
+from consensus_specs_tpu.obs import hist, registry
+from consensus_specs_tpu.ops import profiling
+
+
+@pytest.fixture(autouse=True)
+def _clean_profiling():
+    profiling.reset()
+    yield
+    profiling.reset()
+
+
+def _feed(values):
+    h = hist.Histogram()
+    for v in values:
+        h.observe(v)
+    return h
+
+
+def _stream(seed, n, dist="exp"):
+    rng = random.Random(seed)
+    if dist == "exp":
+        return [rng.expovariate(10.0) for _ in range(n)]
+    return [rng.uniform(1e-4, 2.0) for _ in range(n)]
+
+
+# -- bucket map --------------------------------------------------------------
+
+
+def test_bucket_bounds_are_a_fixed_function_of_index():
+    # mergeability rests on this: the same value lands in the same bucket
+    # in every process, and bounds derive from the index alone
+    for v in (1e-6, 0.001, 0.5, 1.0, 7.25, 100.0):
+        i = hist.bucket_index(v)
+        assert hist.bucket_lower(i) < v <= hist.bucket_upper(i) or (
+            # lower edge exactness: 2^(i/8) itself belongs to bucket i-?
+            v == hist.bucket_lower(i))
+        assert hist.bucket_upper(i) / max(hist.bucket_lower(i), 1e-300) \
+            <= hist.WIDTH_FACTOR + 1e-12 or i == hist.MIN_INDEX
+
+
+def test_extreme_values_clamp_to_edge_buckets():
+    assert hist.bucket_index(1e-300) == hist.MIN_INDEX
+    assert hist.bucket_index(1e300) == hist.MAX_INDEX
+    assert hist.bucket_index(0.0) == hist.MIN_INDEX - 1  # zero bucket
+    assert hist.bucket_index(-1.0) == hist.MIN_INDEX - 1
+    h = _feed([0.0, 1e-300, 1e300])
+    assert h.count == 3 and len(h.state()["counts"]) == 3
+
+
+# -- percentile agreement (the reservoir-replacement acceptance bar) ---------
+
+
+@pytest.mark.parametrize("dist", ["exp", "uniform"])
+@pytest.mark.parametrize("q", [50.0, 95.0, 99.0])
+def test_percentiles_agree_with_exact_nearest_rank_within_one_bucket(q, dist):
+    """On identical input streams the histogram percentile must sit
+    within one bucket width (factor 2^(1/8) ≈ 1.0905) of the exact
+    nearest-rank percentile — the statistic the Algorithm-R reservoir
+    reported at full retention."""
+    values = _stream(11, 4000, dist)
+    h = _feed(values)
+    exact = profiling._percentile(sorted(values), q)
+    got = h.percentile(q)
+    assert exact > 0
+    ratio = got / exact
+    assert 1.0 / hist.WIDTH_FACTOR - 1e-9 <= ratio <= hist.WIDTH_FACTOR + 1e-9, (
+        f"p{q} {dist}: exact={exact} hist={got} ratio={ratio}"
+    )
+
+
+def test_percentiles_clamp_to_observed_extremes():
+    h = _feed([0.25])
+    assert h.percentile(50) == 0.25  # single observation is exact
+    h2 = _feed([0.1] * 99 + [0.9])
+    assert h2.percentile(100) <= 0.9 + 1e-12
+    assert h2.percentile(1) >= 0.1 - 1e-12
+
+
+def test_count_over_reads_error_mass_from_buckets():
+    h = _feed([0.01] * 90 + [1.0] * 10)
+    assert h.count_over(0.5) == 10
+    assert h.count_over(2.0) == 0
+    # threshold inside the 0.01 bucket: that bucket's mass stays below
+    assert h.count_over(0.01) == 10
+
+
+# -- merge algebra ------------------------------------------------------------
+
+
+def test_merge_commutes_and_split_feed_equals_single_feed():
+    values = _stream(7, 3000)
+    whole = _feed(values)
+    a = _feed(values[0::2])
+    b = _feed(values[1::2])
+    ab, ba = a.merge(b), b.merge(a)
+    for merged in (ab, ba):
+        st, wt = merged.state(), whole.state()
+        assert st["counts"] == wt["counts"]
+        assert st["count"] == wt["count"]
+        assert st["min"] == wt["min"] and st["max"] == wt["max"]
+        assert st["sum"] == pytest.approx(wt["sum"], rel=1e-9)
+    assert ab.state()["counts"] == ba.state()["counts"]
+
+
+def test_merge_is_associative():
+    values = _stream(13, 3000)
+    a, b, c = (_feed(values[i::3]) for i in range(3))
+    left = a.merge(b).merge(c)
+    right = a.merge(b.merge(c))
+    assert left.state()["counts"] == right.state()["counts"]
+    assert left.count == right.count == len(values)
+    # and every percentile read off the merged fleet view matches the
+    # single-process view exactly (identical bucket contents)
+    whole = _feed(values)
+    for q in (50, 95, 99):
+        assert left.percentile(q) == whole.percentile(q)
+
+
+def test_merge_leaves_inputs_untouched():
+    a, b = _feed([0.1, 0.2]), _feed([0.3])
+    merged = a.merge(b)
+    assert (a.count, b.count, merged.count) == (2, 1, 3)
+    a.observe(0.4)
+    assert merged.count == 3  # detached
+
+
+# -- Prometheus exposition ----------------------------------------------------
+
+_BUCKET_RE = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)_bucket\{le="(?P<le>[^"]+)"\} '
+    r"(?P<cum>\d+)$"
+)
+
+
+def test_prometheus_histogram_lines_render_and_parse():
+    """/metrics carries full histogram families: monotone cumulative
+    ``_bucket`` series with ascending ``le`` bounds ending at ``+Inf``,
+    plus consistent ``_sum``/``_count`` — parsed here line by line."""
+    values = _stream(5, 500)
+    for v in values:
+        profiling.record_latency("serve.submit_to_result", v)
+    text = registry.render_prometheus()
+    fam = "consensus_specs_tpu_serve_submit_to_result_latency_hist_seconds"
+    buckets = []
+    the_sum = the_count = None
+    for line in text.splitlines():
+        if line.startswith("#") or not line.startswith(fam):
+            continue
+        m = _BUCKET_RE.match(line)
+        if m:
+            buckets.append((m.group("le"), int(m.group("cum"))))
+        elif line.startswith(fam + "_sum "):
+            the_sum = float(line.rsplit(" ", 1)[1])
+        elif line.startswith(fam + "_count "):
+            the_count = int(line.rsplit(" ", 1)[1])
+    assert buckets and buckets[-1][0] == "+Inf"
+    les = [float(le) for le, _ in buckets[:-1]]
+    assert les == sorted(les)  # ascending bounds
+    cums = [c for _, c in buckets]
+    assert cums == sorted(cums)  # monotone cumulative counts
+    assert cums[-1] == the_count == len(values)
+    assert the_sum == pytest.approx(sum(values), rel=1e-6)
+    # the PR 4 summary surface coexists (same family base, _latency_seconds)
+    assert f'{fam.replace("_hist", "")}{{quantile="0.99"}}' in text
+
+
+# -- concurrency hammer -------------------------------------------------------
+
+
+def test_concurrent_writers_vs_merge_and_scrape_readers():
+    """Writer threads observing into shared histograms (direct + through
+    profiling.record_latency) race readers doing merge(), percentile(),
+    and full Prometheus scrapes. Assertions: no exceptions in flight,
+    exact final counts, and every mid-flight merge was self-consistent."""
+    shared = [hist.Histogram() for _ in range(3)]
+    n_threads, iters = 4, 500
+    errors = []
+    done = threading.Event()
+
+    def writer(tid):
+        try:
+            rng = random.Random(tid)
+            for i in range(iters):
+                v = rng.expovariate(100.0)
+                shared[i % len(shared)].observe(v)
+                profiling.record_latency("serve.submit_to_result", v)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    def reader():
+        try:
+            while not done.is_set():
+                merged = shared[0].merge(shared[1]).merge(shared[2])
+                # self-consistency under concurrent writes: bucket mass
+                # equals the merged count at the moment of each snapshot
+                assert sum(merged.state()["counts"].values()) == merged.count
+                merged.percentile(99)
+                registry.render_prometheus()
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer, args=(t,))
+               for t in range(n_threads)]
+    r = threading.Thread(target=reader)
+    r.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    done.set()
+    r.join(30)
+    assert errors == []
+    total = sum(h.count for h in shared)
+    assert total == n_threads * iters
+    fleet = shared[0].merge(shared[1]).merge(shared[2])
+    assert fleet.count == total
+    assert profiling.latency_summary()["serve.submit_to_result"]["n"] == total

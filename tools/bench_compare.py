@@ -25,6 +25,19 @@ Threshold: ``--max-regression`` percent (default: env
 ``BENCH_COMPARE_MAX_REGRESSION`` or 30). CPU committee numbers jitter a
 few percent round over round on shared hosts; 30% catches a lost
 optimization without flapping on noise. Improvements never fail.
+
+SLO gating: rounds that carry an ``slo`` section (the serve/head benches
+emit one — per-objective ``ok`` + ``margin`` = objective/attained) are
+gated on OBJECTIVE STATE, not on margin jitter: a previously-met
+objective that the newest round VIOLATES fails the gate outright, while
+margin movement within "met" is reported but never fails (CPU tail
+latencies jitter far more than throughput means; the page-worthy event is
+crossing the objective, and that is exactly what fails).
+
+Output: the comparison table is also emitted as GitHub-flavored markdown
+— appended to ``$GITHUB_STEP_SUMMARY`` when CI sets it, printed to stdout
+otherwise — so the round-over-round numbers land on the workflow summary
+page without artifact digging.
 """
 import argparse
 import glob
@@ -91,9 +104,59 @@ def extract(doc):
     return out
 
 
+def extract_slo(doc):
+    """{``platform:slo:<objective>``: {"ok", "margin"}} from one round's
+    ``slo`` section (objectives with no traffic carry no margin and are
+    skipped — nothing to gate)."""
+    parsed = doc.get("parsed")
+    if not isinstance(parsed, dict) or "error" in parsed:
+        return {}
+    section = parsed.get("slo")
+    if not isinstance(section, dict):
+        return {}
+    plat = _platform(parsed)
+    out = {}
+    for name, row in sorted(section.items()):
+        if not isinstance(row, dict) or row.get("n", 0) <= 0:
+            continue
+        try:
+            margin = float(row.get("margin", 0.0))
+        except (TypeError, ValueError):
+            continue
+        out[f"{plat}:slo:{name}"] = {
+            "ok": bool(row.get("ok", False)),
+            "margin": margin,
+        }
+    return out
+
+
 def _load(path):
     with open(path) as fh:
         return json.load(fh)
+
+
+def _emit_markdown(rows, prev_name, new_name, threshold_pct):
+    """The comparison as a GitHub-flavored markdown table: appended to
+    ``$GITHUB_STEP_SUMMARY`` when CI provides one, stdout otherwise.
+    ``rows`` are (key, old, new, delta_frac|None, status) tuples."""
+    lines = [
+        f"### bench-compare: `{prev_name}` → `{new_name}` "
+        f"(allowed regression {threshold_pct:.0f}%)",
+        "",
+        "| key | previous | newest | delta | status |",
+        "|---|---:|---:|---:|---|",
+    ]
+    for key, old, new, delta, status in rows:
+        delta_s = "—" if delta is None else f"{delta:+.1%}"
+        lines.append(
+            f"| `{key}` | {old} | {new} | {delta_s} | {status} |")
+    body = "\n".join(lines) + "\n"
+    summary_path = os.environ.get("GITHUB_STEP_SUMMARY")
+    if summary_path:
+        with open(summary_path, "a") as fh:
+            fh.write(body + "\n")
+    else:
+        print(body, end="")
 
 
 def main(argv=None) -> int:
@@ -117,7 +180,9 @@ def main(argv=None) -> int:
         return 0
     newest = files[-1]
     try:
-        new_vals = extract(_load(newest))
+        newest_doc = _load(newest)
+        new_vals = extract(newest_doc)
+        new_slo = extract_slo(newest_doc)
     except (OSError, ValueError) as e:
         print(f"bench-compare: FAIL — {os.path.basename(newest)} unreadable: {e}")
         return 1
@@ -131,21 +196,29 @@ def main(argv=None) -> int:
         print("bench-compare: SKIP — only one round; nothing to compare")
         return 0
 
-    prev_vals, prev_path = {}, None
+    prev_vals, prev_slo, prev_path = {}, {}, None
     for path in reversed(files[:-1]):
         try:
-            prev_vals = extract(_load(path))
+            doc = _load(path)
+            prev_vals = extract(doc)
+            prev_slo = extract_slo(doc)
         except (OSError, ValueError):
-            prev_vals = {}
-        if prev_vals:
+            prev_vals, prev_slo = {}, {}
+        # an SLO-only round (headline errored, objectives still recorded)
+        # is a usable baseline for the SLO gate even with no throughput
+        if prev_vals or prev_slo:
             prev_path = path
             break
-    if not prev_vals:
+    if not prev_vals and not prev_slo:
         print("bench-compare: SKIP — no earlier round recorded a usable value")
         return 0
 
     common = sorted(set(new_vals) & set(prev_vals))
-    if not common:
+    slo_common = sorted(set(new_slo) & set(prev_slo))
+    if not common and not slo_common:
+        # SLO keys count as comparables too: two rounds that share no
+        # throughput shape but both declare serve_p99 must still gate the
+        # objective state, not skip past it
         print(
             "bench-compare: SKIP — no comparable (platform, shape) keys "
             f"between {os.path.basename(prev_path)} "
@@ -156,6 +229,7 @@ def main(argv=None) -> int:
 
     threshold = args.max_regression / 100.0
     failures = []
+    rows = []  # markdown table source
     print(
         f"bench-compare: {os.path.basename(prev_path)} -> "
         f"{os.path.basename(newest)} (allowed regression "
@@ -166,15 +240,44 @@ def main(argv=None) -> int:
         delta = (new - old) / old
         marker = "  REGRESSION" if delta < -threshold else ""
         print(f"  {key}: {old:.2f} -> {new:.2f} ({delta:+.1%}){marker}")
+        rows.append((key, f"{old:.2f}", f"{new:.2f}", delta,
+                     "REGRESSION" if delta < -threshold else "ok"))
         if delta < -threshold:
             failures.append(key)
+
+    # SLO state gate: a previously-met objective the newest round violates
+    # fails outright; margin jitter within "met" is report-only (tail
+    # latencies flap far more than throughput — the page-worthy event is
+    # crossing the objective)
+    for key in slo_common:
+        old, new = prev_slo[key], new_slo[key]
+        violated = old["ok"] and not new["ok"]
+        status = "SLO VIOLATED" if violated else (
+            "ok" if new["ok"] else "still violated")
+        print(
+            f"  {key}: margin {old['margin']:.2f} -> {new['margin']:.2f} "
+            f"(ok: {old['ok']} -> {new['ok']}){'  ' + status if violated else ''}"
+        )
+        rows.append((key, f"{old['margin']:.2f}x", f"{new['margin']:.2f}x",
+                     (new["margin"] - old["margin"]) / old["margin"]
+                     if old["margin"] else None,
+                     status))
+        if violated:
+            failures.append(key)
+
+    _emit_markdown(rows, os.path.basename(prev_path),
+                   os.path.basename(newest), args.max_regression)
     if failures:
         print(
-            f"bench-compare: FAIL — headline regressed more than "
-            f"{args.max_regression:.0f}% on: {', '.join(failures)}"
+            f"bench-compare: FAIL — regressed past the gate on: "
+            f"{', '.join(failures)}"
         )
         return 1
-    print(f"bench-compare: OK — {len(common)} comparable key(s) within bounds")
+    print(
+        f"bench-compare: OK — {len(common)} comparable key(s) within "
+        f"bounds" + (f", {len(slo_common)} SLO key(s) met"
+                     if slo_common else "")
+    )
     return 0
 
 
