@@ -18,7 +18,8 @@ CHEAP_GENERATORS = shuffling bls ssz_generic merkle
 
 .PHONY: test citest test_tpu_backend lint vmlint vm-cache-prune generate_tests \
         detect_generator_incomplete check_vectors bench serve-bench codec-bench multichip \
-        clean_vectors generate_random_tests bench-compare check serve-trace head-bench docs
+        clean_vectors generate_random_tests bench-compare check serve-trace head-bench docs \
+        sim-bench sim-smoke
 
 # fast default: BLS stubbed except @always_bls, 4-way process-parallel
 # (reference `make test` = pytest -n 4, reference Makefile:100)
@@ -109,7 +110,7 @@ bench-compare:
 	python tools/bench_compare.py
 
 # the static + perf check flow CI runs alongside the test matrix
-check: lint vmlint bench-compare
+check: lint vmlint bench-compare sim-smoke
 
 # streaming serve plane (consensus_specs_tpu/serve/): short CPU-sized
 # synthetic gossip load — Poisson arrivals, duplicate-heavy traffic, one
@@ -145,6 +146,25 @@ codec-bench:
 # mid-replay so the JSON line proves the chain.* gauges answer under load
 head-bench:
 	JAX_PLATFORMS=cpu SERVE_METRICS_PORT=0 python bench.py --mode head
+
+# adversarial multi-node network simulation (consensus_specs_tpu/sim/):
+# every named scenario class — partition/heal, latency skew, lossy links,
+# equivocating proposals, withheld-block orphans, long-range reorgs,
+# censored aggregates — runs N independent HeadService nodes over the
+# deterministic discrete-event gossip fabric; the JSON line reports the
+# convergence matrix (every honest head bit-identical to spec.get_head on
+# the union view), heal-to-convergence latency, and per-node heads/sec.
+# Per-node flight journals land in sim_flight/ (CONSENSUS_SPECS_TPU_SIM_*
+# env resizes the run)
+sim-bench:
+	JAX_PLATFORMS=cpu CONSENSUS_SPECS_TPU_SIM_FLIGHT_DIR=sim_flight python bench.py --mode sim
+
+# CI convergence canary (part of `make check`): one small 4-node
+# partition-and-heal scenario through the STRICT differential gate,
+# dumping per-node flight journals to sim_flight/ — uploaded as CI
+# artifacts on failure; exits nonzero with the divergence diagnosis
+sim-smoke:
+	JAX_PLATFORMS=cpu python -m consensus_specs_tpu.sim.smoke
 
 # final-exp microbenchmark: per-item easy+hard finalization vs the RLC
 # combine (one final exponentiation per batch) on identical Miller

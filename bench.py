@@ -64,6 +64,18 @@ finalization vs the random-linear-combination combine
 (bls_backend.batch_verify_rlc's core) on identical Miller outputs,
 items/sec across N in {4,16,64,256} (RLC_BENCH_* env).
 
+`--mode sim` is the adversarial multi-node network simulation
+(consensus_specs_tpu/sim/): every named scenario class — partition/heal,
+latency skew, lossy links, equivocating proposals, withheld-block
+orphans, long-range reorg attempts, censored aggregates — runs N
+independent HeadService+VerificationService nodes over a deterministic
+discrete-event gossip fabric, and the JSON line reports the matrix:
+per-scenario convergence through the differential gate (every honest
+head bit-identical to spec.get_head on the union view), partition
+heal-to-convergence latency, per-node heads/sec, and the fault mix
+(CONSENSUS_SPECS_TPU_SIM_* env knobs; the `sim` section is gated round
+over round by tools/bench_compare.py — a newly diverging scenario fails).
+
 `--mode head` is the chain-plane bench: a synthetic fork-and-gossip
 replay (consensus_specs_tpu/bench/head_replay.py) through the
 HeadService + proto-array vs the spec-store `get_head` recompute, at
@@ -470,6 +482,19 @@ def main():
         from consensus_specs_tpu.bench.head_replay import run_head_bench
 
         _emit_result(run_head_bench())
+        return
+
+    if _cli_mode() == "sim":
+        # adversarial multi-node simulation: N HeadService nodes over the
+        # discrete-event gossip fabric, scenario matrix + convergence
+        # gate. CPU-forced — the thing measured is the consensus plane
+        # under network faults, not device math
+        from consensus_specs_tpu.utils.jax_env import force_cpu
+
+        force_cpu()
+        from consensus_specs_tpu.bench.sim_matrix import run_sim_bench
+
+        _emit_result(run_sim_bench())
         return
 
     if _cli_mode() == "rlc":

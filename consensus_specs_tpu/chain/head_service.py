@@ -25,8 +25,15 @@ inline; tests/test_chain*.py gate it).
 
 Gossip reality is handled the way real clients do:
 - attestations for **unknown blocks** or **future slots/epochs** are
-  parked in a bounded deferral buffer and retried when a block arrives
-  or the clock ticks ("delay consideration", fork-choice.md);
+  parked in a bounded deferral buffer keyed by the MISSING DEPENDENCY
+  and retried when that dependency can resolve: a block arrival retries
+  only the entries whose missing root is now known, a clock tick retries
+  everything (time is a trigger for every defer reason). Unrelated block
+  arrivals never consume an entry's retry budget — under simulated
+  reordering (sim/), an attestation heard before its target block must
+  survive arbitrarily many interleaved third-party blocks and still
+  apply when its own block finally lands, whatever the delivery order
+  ("delay consideration", fork-choice.md);
 - attestations with **invalid signatures**, inconsistent FFG/LMD votes,
   or malformed committees are dropped and counted;
 - everything observable exports through ``chain.*`` metrics
@@ -90,23 +97,34 @@ class HeadService:
                  metrics: Optional[ChainMetrics] = None, tracer=None,
                  differential: Optional[bool] = None,
                  max_deferred: int = 4096, defer_retries: int = 8,
-                 verify_timeout: float = 120.0):
+                 verify_timeout: float = 120.0, node: Optional[str] = None,
+                 recorder=None):
         self.spec = spec
+        self.node = node
         self.store = spec.get_forkchoice_store(anchor_state, anchor_block)
         self._service = service
-        self.metrics = metrics or ChainMetrics()
+        # `node` labels the whole metric family (chain[<node>].<name>) so
+        # N instances — one per simnet node — coexist in one process
+        self.metrics = metrics or ChainMetrics(node=node)
         self._tracer = tracer if tracer is not None else tracing.maybe_tracer()
         # flight recorder (obs/flight.py): chain-plane forensics — block
-        # arrivals, deferrals, drops, prunes. None when disabled; every
+        # arrivals, deferrals, drops, prunes. An explicit per-instance
+        # recorder wins (simnet hands each node its own journal);
+        # otherwise the env-gated global one. None when disabled; every
         # site guards on `is not None` (the tracer's zero-cost contract)
-        self._flight = flight.maybe_recorder()
+        self._flight = recorder if recorder is not None \
+            else flight.maybe_recorder()
         if differential is None:
             differential = os.environ.get(DIFF_ENV, "0") not in ("", "0")
         self._differential = differential
         self._max_deferred = max_deferred
         self._defer_retries = defer_retries
         self._verify_timeout = verify_timeout
-        self._deferred: "deque[Tuple[object, int]]" = deque()
+        # (attestation, attempts, missing) — `missing` is the block root
+        # the entry is waiting on, or None for time-gated defers (future
+        # slot/epoch). Attempts only tick when the entry's own trigger
+        # fired and it STILL re-deferred, never on unrelated arrivals.
+        self._deferred: "deque[Tuple[object, int, object]]" = deque()
 
         self.fc = ProtoForkChoice()
         anchor_root = bytes(spec.hash_tree_root(anchor_block))
@@ -156,10 +174,34 @@ class HeadService:
         checkpoint_moved = self._refresh_checkpoints()
         retry = []
         if slot_advanced and self._deferred:
-            retry = list(self._deferred)
+            # time moved: every defer reason is re-examinable (future
+            # slots unlock, stale epochs become droppable). Only
+            # TIME-gated entries are charged a retry attempt — a
+            # block-gated entry's trigger is its missing root, so ticks
+            # re-route it uncharged (stale-epoch eviction still applies)
+            retry = [(att, attempts, missing is None)
+                     for att, attempts, missing in self._deferred]
             self._deferred.clear()
         if retry or checkpoint_moved:
             self._ingest_batch([], retries=retry)
+
+    def _take_resolved_deferred(self) -> list:
+        """Deferred entries whose missing dependency is now in the store
+        — the ONLY entries a block arrival may retry (charged: their
+        trigger fired). Entries waiting on a still-unknown root (or on
+        the clock) stay parked with their retry budget untouched, which
+        is what makes the buffer's outcome independent of the order
+        unrelated blocks arrive in."""
+        if not self._deferred:
+            return []
+        resolved, keep = [], deque()
+        for att, attempts, missing in self._deferred:
+            if missing is not None and missing in self.store.blocks:
+                resolved.append((att, attempts, True))
+            else:
+                keep.append((att, attempts, missing))
+        self._deferred = keep
+        return resolved
 
     def on_block(self, signed_block, process_attestations: bool = True) -> None:
         """Full spec ``on_block`` (state transition included), then the
@@ -184,9 +226,7 @@ class HeadService:
                               deferred_pending=len(self._deferred))
         self._refresh_checkpoints()
         batch = list(block.body.attestations) if process_attestations else []
-        retry = list(self._deferred)
-        self._deferred.clear()
-        self._ingest_batch(batch, retries=retry)
+        self._ingest_batch(batch, retries=self._take_resolved_deferred())
 
     def on_attestation(self, attestation) -> dict:
         return self.on_attestations([attestation])
@@ -198,10 +238,13 @@ class HeadService:
 
     # -- pipeline ------------------------------------------------------------
 
-    def _classify(self, attestation) -> str:
+    def _classify(self, attestation) -> Tuple[str, object]:
         """The spec's ``validate_on_attestation`` checks, split into
         "apply now" / "delay consideration" (the spec's own wording for
-        unknown blocks and future slots/epochs) / "never valid"."""
+        unknown blocks and future slots/epochs) / "never valid". Returns
+        ``(verdict, missing)``: for DEFER, ``missing`` is the unknown
+        block root the entry waits on, or None when only the clock gates
+        it — the key the deferral buffer retries on."""
         spec, store = self.spec, self.store
         data = attestation.data
         target = data.target
@@ -209,22 +252,23 @@ class HeadService:
         previous_epoch = (current_epoch - 1 if current_epoch > spec.GENESIS_EPOCH
                           else spec.GENESIS_EPOCH)
         if target.epoch not in (current_epoch, previous_epoch):
-            return DEFER if target.epoch > current_epoch else DROP
+            return (DEFER, None) if target.epoch > current_epoch \
+                else (DROP, None)
         if target.epoch != spec.compute_epoch_at_slot(data.slot):
-            return DROP
+            return DROP, None
         if target.root not in store.blocks:
-            return DEFER
+            return DEFER, target.root
         if data.beacon_block_root not in store.blocks:
-            return DEFER
+            return DEFER, data.beacon_block_root
         if store.blocks[data.beacon_block_root].slot > data.slot:
-            return DROP
+            return DROP, None
         target_slot = spec.compute_start_slot_at_epoch(target.epoch)
         if target.root != spec.get_ancestor(store, data.beacon_block_root,
                                             target_slot):
-            return DROP
+            return DROP, None
         if spec.get_current_slot(store) < data.slot + 1:
-            return DEFER
-        return OK
+            return DEFER, None
+        return OK, None
 
     def _prepare(self, attestation) -> Optional[_Prepared]:
         """Index the attestation against its target checkpoint state and
@@ -257,7 +301,10 @@ class HeadService:
 
     def _ingest_batch(self, attestations: List, retries: List = ()) -> dict:
         """The per-batch pipeline shared by every ingress path. ``retries``
-        carries (attestation, attempts) deferral entries riding along."""
+        carries ``(attestation, attempts, charge)`` deferral entries
+        riding along — ``charge`` says whether this retry counts against
+        the entry's budget (its own trigger fired) or is incidental (a
+        tick re-examining a block-gated entry for staleness)."""
         t0 = time.perf_counter()
         trace = None
         if self._tracer is not None:
@@ -267,8 +314,8 @@ class HeadService:
                    "resolved": 0}
         prepared: List[Tuple[_Prepared, bool]] = []  # (item, was_deferred)
 
-        def route(att, attempts, was_deferred):
-            verdict = self._classify(att)
+        def route(att, attempts, was_deferred, charge=True):
+            verdict, missing = self._classify(att)
             if verdict == OK:
                 item = self._prepare(att)
                 if item is None:
@@ -278,13 +325,14 @@ class HeadService:
                     prepared.append((item, was_deferred))
             elif verdict == DEFER and attempts < self._defer_retries \
                     and len(self._deferred) < self._max_deferred:
-                self._deferred.append((att, attempts + 1))
+                attempts = attempts + 1 if charge else attempts
+                self._deferred.append((att, attempts, missing))
                 summary["deferred"] += 1
                 self.metrics.note_deferred(len(self._deferred))
                 if self._flight is not None:
                     self._flight.note("chain", "defer",
                                       slot=int(att.data.slot),
-                                      attempts=attempts + 1,
+                                      attempts=attempts,
                                       pending=len(self._deferred))
             else:  # never valid, retries exhausted, or buffer full
                 summary["dropped"] += 1
@@ -296,8 +344,8 @@ class HeadService:
 
         for att in attestations:
             route(att, 0, was_deferred=False)
-        for att, attempts in retries:
-            route(att, attempts, was_deferred=True)
+        for att, attempts, charge in retries:
+            route(att, attempts, was_deferred=True, charge=charge)
         t1 = time.perf_counter()
 
         # the whole batch's signature checks are in the service's
@@ -418,9 +466,9 @@ class HeadService:
         transition (the synthetic fork replays in ``bench/head_replay.py``
         build trees whose states are crafted, not computed). Never use on
         a live store — ``on_block`` is the validated path. ``resolve``
-        additionally retries deferred gossip and sweeps (a block arrival
-        on the validated path always does); bulk imports leave it off and
-        call ``resweep()`` once."""
+        additionally retries the deferred gossip this arrival can resolve
+        and sweeps (a block arrival on the validated path always does);
+        bulk imports leave it off and call ``resweep()`` once."""
         spec, store = self.spec, self.store
         root = spec.hash_tree_root(block)
         if root in store.blocks:
@@ -436,10 +484,12 @@ class HeadService:
         self.fc.on_block(bytes(root), bytes(block.parent_root),
                          int(block.slot), *cps)
         self.metrics.note_block()
+        if self._flight is not None:
+            self._flight.note("chain", "on_block", slot=int(block.slot),
+                              root=bytes(root).hex()[:16],
+                              deferred_pending=len(self._deferred))
         if resolve:
-            retry = list(self._deferred)
-            self._deferred.clear()
-            self._ingest_batch([], retries=retry)
+            self._ingest_batch([], retries=self._take_resolved_deferred())
 
     def resweep(self) -> None:
         """Force one sweep + head refresh (after bulk unchecked imports)."""

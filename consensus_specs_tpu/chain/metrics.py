@@ -5,10 +5,18 @@ Counters live on the owning :class:`HeadService`; the derived values
 export through ``ops/profiling`` (the ``chain.*`` family in
 ``obs/registry.py``) so ``/metrics`` scrapes and bench JSON lines carry
 the chain numbers the same way they carry the serve plane's.
+
+Multi-instance runs (the ``sim/`` plane drives N ``HeadService``
+instances in one process) pass ``node=``: every label then exports in
+the node-labelled form ``chain[<node>].<name>`` (the ``chain[`` dynamic
+family in ``obs/registry.py``) instead of the bare ``chain.*`` name, so
+N instances publish side by side instead of overwriting one shared
+gauge.
 """
 import threading
-from typing import Dict
+from typing import Dict, Optional
 
+from ..obs.registry import node_label
 from ..ops import profiling
 
 APPLY_LABEL = "chain.apply_batch"
@@ -30,9 +38,14 @@ GAUGE_LABELS = (
 
 
 class ChainMetrics:
-    """Counters for one HeadService instance."""
+    """Counters for one HeadService instance. ``node`` labels every
+    exported metric for multi-instance (simnet) processes."""
 
-    def __init__(self):
+    def __init__(self, node: Optional[str] = None):
+        self.node = node
+        self._apply_label = node_label(APPLY_LABEL, node)
+        self._gauge_labels = tuple(
+            node_label(label, node) for label in GAUGE_LABELS)
         self._lock = threading.Lock()
         self.blocks = 0
         self.batches = 0
@@ -83,7 +96,7 @@ class ChainMetrics:
     def note_batch(self, seconds: float) -> None:
         with self._lock:
             self.batches += 1
-        profiling.record_latency(APPLY_LABEL, seconds)
+        profiling.record_latency(self._apply_label, seconds)
 
     def note_head(self, slot: int, changed: bool, reorg_depth: int) -> None:
         with self._lock:
@@ -111,11 +124,11 @@ class ChainMetrics:
                 self.dropped,
                 self.deferred_pending,
             )
-        for label, value in zip(GAUGE_LABELS, values):
+        for label, value in zip(self._gauge_labels, values):
             profiling.set_gauge(label, value)
 
     def snapshot(self) -> Dict[str, float]:
-        lat = profiling.latency_summary().get(APPLY_LABEL, {})
+        lat = profiling.latency_summary().get(self._apply_label, {})
         with self._lock:
             return {
                 "blocks": self.blocks,

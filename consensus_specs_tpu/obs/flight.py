@@ -60,12 +60,17 @@ class FlightRecorder:
     One plain lock per ``note()`` — journal sites are flush/batch/program
     scale, not per-limb scale, and the critical section is an append to a
     preallocated deque. ``clock`` is injectable for deterministic tests.
+
+    ``node`` stamps every journaled event (a top-level ``node`` key, not
+    payload data) so per-instance recorders — one per simnet node — stay
+    attributable after their journals are merged or dumped side by side.
     """
 
     def __init__(self, capacity: int = DEFAULT_RING,
-                 clock=time.perf_counter):
+                 clock=time.perf_counter, node: Optional[str] = None):
         assert capacity > 0
         self._clock = clock
+        self.node = node
         self._lock = threading.Lock()
         self._ring: "deque[Dict]" = deque(maxlen=capacity)
         self._seq = 0
@@ -76,17 +81,21 @@ class FlightRecorder:
 
     def note(self, plane: str, kind: str, **data) -> None:
         t = self._clock()
+        event = {
+            "seq": 0,
+            "t": t,
+            "plane": plane,
+            "kind": kind,
+            "data": data,
+        }
+        if self.node is not None:
+            event["node"] = self.node
         with self._lock:
             self._seq += 1
+            event["seq"] = self._seq
             if len(self._ring) == self._ring.maxlen:
                 self._dropped += 1
-            self._ring.append({
-                "seq": self._seq,
-                "t": t,
-                "plane": plane,
-                "kind": kind,
-                "data": data,
-            })
+            self._ring.append(event)
 
     # -- reading -------------------------------------------------------------
 
@@ -125,6 +134,8 @@ class FlightRecorder:
                 "retained": len(events),
                 "dropped": self._dropped,
             }
+            if self.node is not None:
+                header["node"] = self.node
         lines = [json.dumps(header, sort_keys=True)]
         for e in events:
             e["t"] = round(e["t"], 6)
@@ -174,10 +185,13 @@ class FlightRecorder:
                     "ph": "M", "name": "thread_name", "pid": 4, "tid": tid,
                     "args": {"name": f"flight-{plane}"},
                 })
+            args = dict(e["data"], seq=e["seq"])
+            if "node" in e:
+                args["node"] = e["node"]
             out.append({
                 "name": f"{plane}.{e['kind']}", "cat": "flight", "ph": "i",
                 "s": "t", "pid": 4, "tid": tid, "ts": us_fn(e["t"]),
-                "args": dict(e["data"], seq=e["seq"]),
+                "args": args,
             })
         return out
 

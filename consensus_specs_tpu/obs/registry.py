@@ -138,7 +138,33 @@ DYNAMIC_PREFIXES: Dict[str, tuple] = {
     "device[": ("device_busy_frac", "per-device occupancy (busy seconds / "
                                     "elapsed), labelled device[<index>] "
                                     "(device[host] is the prep lane)"),
+    # node-labelled instance families (simnet: N HeadService /
+    # VerificationService instances in ONE process — the bare chain.* /
+    # serve.* gauges would collide, so each instance exports under
+    # chain[<node>].<name> / serve[<node>].<name> via node_label())
+    "chain[": ("chain_node", "per-node chain-plane metrics from multi-"
+                             "instance (simnet) runs, labelled "
+                             "chain[<node>].<name> — same names as the "
+                             "chain.* family"),
+    "serve[": ("serve_node", "per-node serve-plane metrics from multi-"
+                             "instance (simnet) runs, labelled "
+                             "serve[<node>].<name> — same names as the "
+                             "serve.* family"),
 }
+
+
+def node_label(base: str, node) -> str:
+    """``chain.head_slot`` -> ``chain[<node>].head_slot`` when a node name
+    is set — the one spelling of the instance-labelled form, shared by
+    chain/metrics.py and serve/metrics.py so the two planes cannot drift.
+    ``node`` None returns ``base`` unchanged (the single-instance shape).
+    """
+    if node is None:
+        return base
+    plane, name = base.split(".", 1)
+    label = f"{plane}[{node}].{name}"
+    assert known(label), f"unregistered node-labelled family for {base!r}"
+    return label
 
 
 def all_names() -> Iterable[str]:

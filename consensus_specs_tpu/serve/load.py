@@ -20,6 +20,8 @@ Env overrides (CPU-sized defaults; a granted TPU window can scale up):
 import os
 import random
 import time
+from collections.abc import Sequence
+from dataclasses import dataclass
 from typing import List, Tuple
 
 from ..ops import profiling
@@ -70,18 +72,64 @@ class FailingBackendProxy:
 
 # -- chain-plane gossip fault injection ---------------------------------------
 #
-# The head replay (bench/head_replay.py) and the chain service tests drive
+# The head replay (bench/head_replay.py), the chain service tests, and the
+# multi-node network simulation (consensus_specs_tpu/sim/) drive
 # attestation gossip through the SAME VerificationService machinery as the
 # signature bench above, but the thing under test is the fork-choice plane,
 # not the pairing math — so the verdicts come from a deterministic
 # crypto-free backend and the faults are planned per event:
-#   "invalid_sig"  the attestation carries BAD_SIGNATURE; the service must
-#                  answer False and the chain plane must DROP it;
-#   "orphan"       the attestation references a block withheld from the
-#                  stream; the chain plane must DEFER it and apply it only
-#                  once the block arrives (deferred-then-resolved).
+#   "invalid_sig"   the attestation carries BAD_SIGNATURE; the service must
+#                   answer False and the chain plane must DROP it;
+#   "orphan"        the attestation references a block withheld from the
+#                   stream; the chain plane must DEFER it and apply it only
+#                   once the block arrives (deferred-then-resolved);
+#   "equivocation"  the adversary pairs the event's block with a
+#                   conflicting twin proposal at the same slot, published
+#                   to a different subset of the network (simnet only —
+#                   single-node replays treat it as "ok");
+#   "censored_agg"  the adversarial aggregator never publishes this
+#                   committee's aggregate — the votes vanish from every
+#                   honest view (simnet counts them; the convergence gate
+#                   excludes them from the union oracle).
 
 BAD_SIGNATURE = b"\xba" * 96  # the injected invalid-signature marker
+
+# every kind a fault plan may carry, in draw-priority order
+FAULT_KINDS = ("ok", "invalid_sig", "orphan", "equivocation", "censored_agg")
+
+
+@dataclass(frozen=True)
+class GossipFaultPlan(Sequence):
+    """The stable per-event fault plan shared by the head replay, the chain
+    service tests, and ``sim/``'s scenario library.
+
+    Sequence-shaped over the per-event kind strings (``plan[e]``,
+    ``len(plan)``, ``plan.count("orphan")`` all work, so pre-dataclass
+    callers are untouched) while carrying the rates that produced it —
+    equality is structural, which is what the seed-determinism gate
+    asserts: same seed, same rates -> identical plan."""
+
+    kinds: Tuple[str, ...]
+    invalid_rate: float = 0.0
+    orphan_rate: float = 0.0
+    equivocation_rate: float = 0.0
+    censor_rate: float = 0.0
+
+    def __post_init__(self):
+        unknown = set(self.kinds) - set(FAULT_KINDS)
+        if unknown:
+            raise ValueError(f"unknown fault kinds in plan: {sorted(unknown)}")
+
+    def __len__(self) -> int:
+        return len(self.kinds)
+
+    def __getitem__(self, index):
+        return self.kinds[index]
+
+    def counts(self) -> dict:
+        """{kind: occurrences} over every kind (zeros included) — the
+        scenario-matrix report's per-plan composition line."""
+        return {kind: self.kinds.count(kind) for kind in FAULT_KINDS}
 
 
 class VerdictBackend:
@@ -109,21 +157,39 @@ class VerdictBackend:
 
 def plan_gossip_faults(rng: random.Random, events: int,
                        invalid_rate: float = 0.0,
-                       orphan_rate: float = 0.0):
-    """Per-event fault plan for an attestation gossip replay: a list of
-    "ok" / "invalid_sig" / "orphan" drawn independently per event. The
-    first event is always clean so a replay never starts with an empty
-    applied set."""
-    plan = []
+                       orphan_rate: float = 0.0,
+                       equivocation_rate: float = 0.0,
+                       censor_rate: float = 0.0) -> GossipFaultPlan:
+    """Per-event fault plan for an attestation gossip replay: one kind
+    from ``FAULT_KINDS`` drawn independently per event (a single uniform
+    draw split across the rate bands, so adding a rate never perturbs the
+    draws of the kinds before it at a fixed seed). The first event is
+    always clean so a replay never starts with an empty applied set."""
+    kinds = []
+    bands = (
+        ("invalid_sig", invalid_rate),
+        ("orphan", orphan_rate),
+        ("equivocation", equivocation_rate),
+        ("censored_agg", censor_rate),
+    )
     for e in range(events):
         draw = rng.random()
-        if e and draw < invalid_rate:
-            plan.append("invalid_sig")
-        elif e and draw < invalid_rate + orphan_rate:
-            plan.append("orphan")
-        else:
-            plan.append("ok")
-    return plan
+        kind = "ok"
+        if e:
+            upper = 0.0
+            for name, rate in bands:
+                upper += rate
+                if draw < upper:
+                    kind = name
+                    break
+        kinds.append(kind)
+    return GossipFaultPlan(
+        kinds=tuple(kinds),
+        invalid_rate=invalid_rate,
+        orphan_rate=orphan_rate,
+        equivocation_rate=equivocation_rate,
+        censor_rate=censor_rate,
+    )
 
 
 def build_committees(n_committees: int, k: int, seed: int = 7

@@ -8,8 +8,9 @@ without bench needing to know the service's internals.
 """
 import sys
 import threading
-from typing import Dict
+from typing import Dict, Optional
 
+from ..obs.registry import node_label
 from ..ops import profiling
 
 # resolved lazily through sys.modules: a service wrapping a lightweight
@@ -43,9 +44,21 @@ class ServeMetrics:
       the batch axis up to a power of two);
     - LANE occupancy: actual committee keys / (rows * K bucket) (each item
       pads its key axis up to its bucket).
+
+    ``node`` labels every exported metric (``serve[<node>].<name>``, the
+    ``serve[`` dynamic family) so N service instances — one per simnet
+    node — publish side by side instead of overwriting shared gauges.
     """
 
-    def __init__(self):
+    def __init__(self, node: Optional[str] = None):
+        self.node = node
+        self._latency_label = node_label(LATENCY_LABEL, node)
+        self._batch_label = node_label(BATCH_LABEL, node)
+        self._prep_label = node_label(PREP_LABEL, node)
+        self._queue_depth_label = node_label("serve.queue_depth", node)
+        self._hit_rate_label = node_label("serve.cache_hit_rate", node)
+        self._occ_rows_label = node_label("serve.occupancy_rows", node)
+        self._occ_lanes_label = node_label("serve.occupancy_lanes", node)
         self._lock = threading.Lock()
         self.submits = 0
         self.eager = 0  # resolved at submit time by the reference's own rules
@@ -100,13 +113,13 @@ class ServeMetrics:
         with self._lock:
             self.enqueued += 1
             self.queue_depth_peak = max(self.queue_depth_peak, queue_depth)
-        profiling.set_gauge("serve.queue_depth", queue_depth)
+        profiling.set_gauge(self._queue_depth_label, queue_depth)
 
     def note_prep(self, seconds: float) -> None:
         with self._lock:
             self.prep_batches += 1
             self.prep_s += seconds
-        profiling.record(PREP_LABEL, seconds)
+        profiling.record(self._prep_label, seconds)
 
     def note_batch(self, n_items: int, sum_k: int, bucket: int,
                    seconds: float) -> None:
@@ -117,7 +130,7 @@ class ServeMetrics:
             self.rows_padded += rows
             self.lanes_filled += sum_k
             self.lanes_padded += rows * bucket
-        profiling.record(BATCH_LABEL, seconds)
+        profiling.record(self._batch_label, seconds)
 
     def note_device_flush(self, seconds: float) -> None:
         with self._lock:
@@ -134,7 +147,7 @@ class ServeMetrics:
             self.fallback_items += n_items
 
     def note_result(self, latency_s: float) -> None:
-        profiling.record_latency(LATENCY_LABEL, latency_s)
+        profiling.record_latency(self._latency_label, latency_s)
 
     # -- derived views ------------------------------------------------------
 
@@ -155,13 +168,13 @@ class ServeMetrics:
 
     def export_gauges(self) -> None:
         """Publish the derived ratios into profiling.summary()."""
-        profiling.set_gauge("serve.cache_hit_rate", self.hit_rate)
-        profiling.set_gauge("serve.occupancy_rows", self.row_occupancy)
-        profiling.set_gauge("serve.occupancy_lanes", self.lane_occupancy)
+        profiling.set_gauge(self._hit_rate_label, self.hit_rate)
+        profiling.set_gauge(self._occ_rows_label, self.row_occupancy)
+        profiling.set_gauge(self._occ_lanes_label, self.lane_occupancy)
 
     def snapshot(self) -> Dict[str, float]:
         self.export_gauges()
-        lat = profiling.latency_summary().get(LATENCY_LABEL, {})
+        lat = profiling.latency_summary().get(self._latency_label, {})
         # backend prep-plane counters (which path warmed the caches, how
         # many items degraded to serial per-item prep, pool-broken latch)
         # — process-global like the caches they describe

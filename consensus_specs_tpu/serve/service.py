@@ -120,7 +120,7 @@ class VerificationService:
     def __init__(self, backend=None, oracle=None, *, max_batch: int = 256,
                  max_wait_ms: float = 20.0, max_queue: int = 4096,
                  cache_capacity: int = 1 << 16, backend_retries: int = 1,
-                 bucket_fn=None, tracer=None):
+                 bucket_fn=None, tracer=None, node=None):
         assert max_batch > 0 and max_queue > 0
         self._backend = backend  # None: resolved lazily on first batch
         # per-request span tracing (obs/tracing.py): an explicit tracer
@@ -158,7 +158,9 @@ class VerificationService:
         self._staged = 0
         self._inflight = {}  # key -> _Pending (queued or mid-batch)
         self._cache = ResultCache(cache_capacity)
-        self.metrics = ServeMetrics()
+        # node labels the whole metric family (serve[<node>].<name>) so N
+        # instances — one per simnet node — coexist in one process
+        self.metrics = ServeMetrics(node=node)
         self._closed = False
         # two-stage pipeline: prep(N+1) overlaps device(N) through a
         # one-slot hand-off queue

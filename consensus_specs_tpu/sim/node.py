@@ -1,0 +1,140 @@
+"""SimNode: one full consensus participant inside the simulated network.
+
+Each node owns the REAL production stack, not a mock of it:
+
+- its own spec ``Store`` + incremental proto-array behind a
+  :class:`~consensus_specs_tpu.chain.HeadService` (so every delivered
+  attestation runs the spec validation pipeline and every delivered
+  block feeds fork choice exactly as live gossip would);
+- its own :class:`~consensus_specs_tpu.serve.service.VerificationService`
+  over the crypto-free ``VerdictBackend`` (batching, dedup, caching and
+  False-verdict routing all exercised; the verdict rides in the
+  signature bytes so synthetic votes skip the pairings);
+- its own node-labelled observability: ``chain[<name>].*`` /
+  ``serve[<name>].*`` metric families and a per-node
+  :class:`~consensus_specs_tpu.obs.flight.FlightRecorder` journaling on
+  the SIMULATED clock — the per-node black boxes ``make sim-bench``
+  dumps and CI uploads on failure.
+
+The node's clock only moves forward, driven by the runner as events
+reach it (``advance_clock``); a partitioned node that hears nothing
+simply stays behind until the heal-time sync fast-forwards it, exactly
+like a real client rejoining.
+"""
+from typing import Set
+
+from ..chain import HeadService
+from ..chain.metrics import ChainMetrics
+from ..obs.flight import FlightRecorder
+from ..serve.load import VerdictBackend
+from ..serve.service import VerificationService
+from .fabric import Message
+
+__all__ = ["SimNode"]
+
+
+class SimNode:
+    """One simulated consensus node (index ``i``, name ``n<i>``)."""
+
+    def __init__(self, index: int, spec, anchor_state, anchor_block,
+                 shared_state, *, honest: bool = True, sim_clock=None,
+                 flight_capacity: int = 4096):
+        self.index = index
+        self.name = f"n{index}"
+        self.honest = honest
+        self.spec = spec
+        self._shared_state = shared_state
+        self._seconds_per_slot = int(spec.config.SECONDS_PER_SLOT)
+        self.recorder = FlightRecorder(
+            capacity=flight_capacity, node=self.name,
+            clock=sim_clock if sim_clock is not None else (lambda: 0.0))
+        self.backend = VerdictBackend()
+        self.service = VerificationService(
+            backend=self.backend, max_batch=8, max_wait_ms=1.0,
+            node=self.name)
+        self.head = HeadService(
+            spec, anchor_state, anchor_block, service=self.service,
+            metrics=ChainMetrics(node=self.name), node=self.name,
+            recorder=self.recorder, differential=False)
+        self._genesis_time = int(anchor_state.genesis_time)
+        self._clock_slot = 0
+        self._seen: Set[str] = set()
+        self.known: list = []  # receipt-ordered Messages (the sync source)
+        self.duplicates = 0
+        # orphan BLOCK buffer (the attestation deferral buffer's sibling):
+        # gossip can deliver a child before its parent, and the proto
+        # array requires parents first — park the child, import it the
+        # moment its parent lands (real clients hold an identical queue)
+        self._orphan_blocks = {}  # parent root bytes -> [block, ...]
+        self.orphaned_blocks = 0
+
+    # -- clock ---------------------------------------------------------------
+
+    def advance_clock(self, sim_t: float) -> None:
+        """Move the node's store clock to the slot containing ``sim_t``
+        (simulation seconds since genesis). Monotone: late events never
+        rewind it. ``on_tick`` retries time-gated deferred gossip."""
+        slot = int(sim_t // self._seconds_per_slot)
+        if slot > self._clock_slot:
+            self._clock_slot = slot
+            self.head.on_tick(
+                self._genesis_time + slot * self._seconds_per_slot)
+
+    # -- gossip ingress ------------------------------------------------------
+
+    def receive(self, msg: Message) -> bool:
+        """Deliver one message; returns True on FIRST receipt (the caller
+        re-broadcasts then — flood gossip's dedup rule)."""
+        if msg.mid in self._seen:
+            self.duplicates += 1
+            return False
+        self._seen.add(msg.mid)
+        self.known.append(msg)
+        if msg.kind == "block":
+            block = msg.payload
+            if block.parent_root not in self.head.store.blocks:
+                self.orphaned_blocks += 1
+                self._orphan_blocks.setdefault(
+                    bytes(block.parent_root), []).append(block)
+            else:
+                self._import_block(block)
+        else:
+            self.head.on_attestations([msg.payload])
+        return True
+
+    def _import_block(self, block) -> None:
+        """Crafted-state ingress (the head-replay contract): register the
+        block, retry exactly the deferred gossip it resolves, then drain
+        any parked children it just re-parented."""
+        self.head.import_block_unchecked(
+            block, state=self._shared_state, resolve=True)
+        root = bytes(self.spec.hash_tree_root(block))
+        for child in self._orphan_blocks.pop(root, ()):
+            self._import_block(child)
+
+    def knows(self, mid: str) -> bool:
+        return mid in self._seen
+
+    # -- reading -------------------------------------------------------------
+
+    def get_head(self) -> bytes:
+        return bytes(self.head.get_head())
+
+    def snapshot(self) -> dict:
+        snap = self.head.metrics.snapshot()
+        return {
+            "applied": snap["applied"],
+            "deferred": snap["deferred"],
+            "resolved": snap["resolved"],
+            "dropped": snap["dropped"],
+            "blocks": snap["blocks"],
+            "head_changes": snap["head_changes"],
+            "reorgs": snap["reorgs"],
+            "head_slot": snap["head_slot"],
+            "deferred_pending": snap["deferred_pending"],
+            "duplicates": self.duplicates,
+            "backend_calls": self.backend.calls,
+        }
+
+    def close(self) -> None:
+        self.service.close(timeout=30)

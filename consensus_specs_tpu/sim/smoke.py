@@ -1,0 +1,44 @@
+"""`make sim-smoke`: the CI convergence canary.
+
+One small 4-node partition-and-heal scenario through the strict
+differential gate, well inside the tier-1 time budget. Per-node flight
+journals always dump to CONSENSUS_SPECS_TPU_SIM_FLIGHT_DIR (default
+``sim_flight/``) — on a failure CI uploads them as artifacts, so the
+post-mortem (every node's block arrivals, deferrals, drops, on the
+simulated clock) exists without a rerun.
+
+Exit status: 0 on convergence, 1 with the divergence diagnosis on
+stderr otherwise — `make check` turns it into a visible failure.
+"""
+import os
+import sys
+
+from .runner import FLIGHT_DIR_ENV, SEED_ENV, build_world, run_scenario
+from .scenarios import get_scenario
+
+
+def main() -> int:
+    flight_dir = (os.environ.get(FLIGHT_DIR_ENV) or "").strip() \
+        or "sim_flight"
+    seed = int(os.environ.get(SEED_ENV, "7"))
+    spec, anchor_state, anchor_block = build_world()
+    report = run_scenario(
+        get_scenario("partition_heal"), spec=spec,
+        anchor_state=anchor_state, anchor_block=anchor_block,
+        seed=seed, strict=False, flight_dir=flight_dir)
+    print(
+        f"sim-smoke: scenario=partition_heal nodes={report.nodes} "
+        f"seed={seed} converged={report.converged} "
+        f"heal_to_convergence={report.heal_to_convergence_s}s "
+        f"deliveries={report.deliveries} "
+        f"diverged_samples={report.diverged_samples} "
+        f"journals={flight_dir}/"
+    )
+    if not report.converged:
+        print(f"sim-smoke: FAIL — {report.error}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -231,6 +231,91 @@ def test_slo_only_previous_round_is_a_usable_baseline(tmp_path, bc,
     assert "SLO VIOLATED" in capsys.readouterr().out
 
 
+def _sim_parsed(value, scenarios, **extra):
+    """A `--mode sim` line: ``scenarios`` maps name -> (converged,
+    heal_to_convergence_s)."""
+    return _parsed(value, mode="sim", n=None, k=None,
+                   sim={name: {"converged": conv,
+                               "heal_to_convergence_s": heal,
+                               "nodes": 4, "deliveries": 500}
+                        for name, (conv, heal) in scenarios.items()},
+                   **extra)
+
+
+def test_sim_newly_diverging_scenario_fails(tmp_path, bc, capsys):
+    """The simnet gate: a scenario that converged last round and
+    diverges in the newest fails outright — differential convergence is
+    a correctness claim, not a perf number."""
+    _write_round(tmp_path, 1, _sim_parsed(
+        1500.0, {"partition_heal": (True, 0.07),
+                 "equivocation": (True, 6.1)}))
+    _write_round(tmp_path, 2, _sim_parsed(
+        1500.0, {"partition_heal": (False, 0.07),
+                 "equivocation": (True, 6.2)}))
+    assert bc.main(["--dir", str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "cpu:sim:partition_heal" in out and "SIM DIVERGED" in out
+
+
+def test_sim_heal_latency_jitter_never_fails(tmp_path, bc, capsys):
+    """Heal-to-convergence latency movement within 'converged' is
+    report-only, like SLO margin jitter."""
+    _write_round(tmp_path, 1, _sim_parsed(
+        1500.0, {"partition_heal": (True, 0.05)}))
+    _write_round(tmp_path, 2, _sim_parsed(
+        1500.0, {"partition_heal": (True, 4.90)}))  # 98x slower heal
+    assert bc.main(["--dir", str(tmp_path)]) == 0
+    assert "cpu:sim:partition_heal" in capsys.readouterr().out
+
+
+def test_sim_still_diverged_is_not_a_new_failure(tmp_path, bc):
+    """converged False -> False: the divergence round already failed
+    once; a permanently-red scenario must not wedge every future round."""
+    _write_round(tmp_path, 1, _sim_parsed(
+        1500.0, {"lossy_links": (False, 0.0)}))
+    _write_round(tmp_path, 2, _sim_parsed(
+        1500.0, {"lossy_links": (False, 0.0)}))
+    assert bc.main(["--dir", str(tmp_path)]) == 0
+
+
+def test_sim_scenarios_join_without_common_throughput_keys(tmp_path, bc,
+                                                           capsys):
+    """Shared sim keys are comparables in their own right (the SLO
+    rule): disjoint throughput shapes must still gate a converged ->
+    diverged transition instead of skipping."""
+    _write_round(tmp_path, 1, _parsed(
+        1000.0, mode="head", n=None, k=None, blocks=1024,
+        sim={"withheld_orphans": {"converged": True,
+                                  "heal_to_convergence_s": 6.0}}))
+    _write_round(tmp_path, 2, _parsed(
+        900.0, mode="head", n=None, k=None, blocks=128,
+        sim={"withheld_orphans": {"converged": False,
+                                  "heal_to_convergence_s": 0.0}}))
+    assert bc.main(["--dir", str(tmp_path)]) == 1
+    assert "SIM DIVERGED" in capsys.readouterr().out
+
+
+def test_sim_per_scenario_throughput_keys_diff(tmp_path, bc, capsys):
+    """The per_mode_best sim[<scenario>] deliveries/sec keys join the
+    throughput comparison like any other shape."""
+    _write_round(tmp_path, 1, _sim_parsed(
+        1500.0, {"partition_heal": (True, 0.07)},
+        per_mode_best={"sim[partition_heal]": 1400.0}))
+    _write_round(tmp_path, 2, _sim_parsed(
+        1500.0, {"partition_heal": (True, 0.07)},
+        per_mode_best={"sim[partition_heal]": 300.0}))  # -79%
+    assert bc.main(["--dir", str(tmp_path)]) == 1
+    assert "cpu:sim[partition_heal]" in capsys.readouterr().out
+
+
+def test_sim_extract_shapes(bc):
+    doc = {"parsed": _sim_parsed(1500.0, {"a": (True, 1.5)})}
+    assert bc.extract_sim(doc) == {
+        "cpu:sim:a": {"converged": True, "heal_s": 1.5}}
+    assert bc.extract_sim({"parsed": {"error": "boom"}}) == {}
+    assert bc.extract_sim({"parsed": _parsed(300.0)}) == {}
+
+
 def test_markdown_table_written_to_github_step_summary(tmp_path, bc,
                                                       monkeypatch):
     summary_file = tmp_path / "summary.md"

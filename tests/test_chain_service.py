@@ -225,8 +225,81 @@ def test_unknown_block_defers_then_resolves(spec, genesis_state):
         svc.close(timeout=30)
 
 
-def test_deferral_retries_exhaust_to_drop(spec, genesis_state):
-    head, state = _service(spec, genesis_state, defer_retries=1)
+def test_deferred_attestation_survives_unrelated_blocks(spec, genesis_state):
+    """The order-independence regression (simnet reordering): an
+    attestation heard before its block must survive MORE unrelated block
+    arrivals than its whole retry budget, then still apply the moment its
+    own block lands via a different peer."""
+    head, state = _service(spec, genesis_state, defer_retries=2)
+    # the attested fork block, withheld from the service for now
+    fork_state = state.copy()
+    block = build_empty_block_for_next_slot(spec, fork_state)
+    block.body.graffiti = spec.Bytes32(b"\x07" * 32)
+    signed = state_transition_and_sign_block(spec, fork_state, block)
+    root = spec.hash_tree_root(block)
+    att = get_valid_attestation(spec, fork_state, slot=block.slot,
+                                signed=False, beacon_block_root=root)
+    _tick_to(spec, head, block.slot + 1)
+    summary = head.on_attestations([att])
+    assert summary["deferred"] == 1 and head.deferred_count == 1
+
+    # five unrelated main-chain blocks arrive — far past defer_retries=2.
+    # None of them resolves the entry, so none may consume its budget
+    # (and the interleaved clock ticks re-examine it uncharged)
+    st = state.copy()
+    for _ in range(5):
+        sb = state_transition_and_sign_block(
+            spec, st, build_empty_block_for_next_slot(spec, st))
+        _tick_to(spec, head, sb.message.slot)
+        head.on_block(sb)
+    assert head.deferred_count == 1, "unrelated arrivals evicted the entry"
+
+    # the attested block finally arrives via "a different peer"
+    head.on_block(signed)
+    snap = head.metrics.snapshot()
+    assert snap["resolved"] == 1 and snap["deferred_pending"] == 0
+    assert head.store.latest_messages  # the vote applied
+    assert bytes(spec.get_head(head.store)) == bytes(head.get_head())
+
+
+def test_deferred_block_vs_attestation_order_is_irrelevant(spec,
+                                                           genesis_state):
+    """Same gossip, two delivery orders (block-then-attestation vs
+    attestation-then-block): identical head and latest messages."""
+    fork_state = genesis_state.copy()
+    block = build_empty_block_for_next_slot(spec, fork_state)
+    signed = state_transition_and_sign_block(spec, fork_state, block)
+    root = spec.hash_tree_root(block)
+
+    def run(block_first: bool):
+        head, _ = _service(spec, genesis_state)
+        att = get_valid_attestation(spec, fork_state.copy(),
+                                    slot=block.slot, signed=False,
+                                    beacon_block_root=root)
+        _tick_to(spec, head, block.slot + 1)
+        if block_first:
+            head.on_block(signed)
+            head.on_attestations([att])
+        else:
+            head.on_attestations([att])
+            head.on_block(signed)
+        table = {
+            int(i): (int(m.epoch), bytes(m.root))
+            for i, m in head.store.latest_messages.items()
+        }
+        return bytes(head.get_head()), table
+
+    head_a, votes_a = run(block_first=True)
+    head_b, votes_b = run(block_first=False)
+    assert head_a == head_b == bytes(root)
+    assert votes_a == votes_b and votes_a
+
+
+def test_stale_deferred_entries_evict_via_epoch_window(spec, genesis_state):
+    """An entry whose block never arrives is evicted by the spec's
+    stale-epoch rule as the clock advances — not leaked, not charged to
+    unrelated arrivals."""
+    head, state = _service(spec, genesis_state)
     never_known = spec.Root(b"\x77" * 32)
     att = get_valid_attestation(spec, state.copy(), slot=state.slot,
                                 signed=False)
@@ -234,11 +307,25 @@ def test_deferral_retries_exhaust_to_drop(spec, genesis_state):
     _tick_to(spec, head, state.slot + 2)
     summary = head.on_attestations([att])
     assert summary["deferred"] == 1
-    # the next block arrival retries once (attempts=1 -> limit), drops
-    st2 = state.copy()
-    signed = state_transition_and_sign_block(
-        spec, st2, build_empty_block_for_next_slot(spec, st2))
-    head.on_block(signed)
+    # clock to epoch 3: target epoch 0 leaves the {current, previous}
+    # window and the tick's (uncharged) re-route drops the entry
+    _tick_to(spec, head, int(spec.SLOTS_PER_EPOCH) * 3)
+    assert head.deferred_count == 0
+    assert head.metrics.snapshot()["dropped"] == 1
+
+
+def test_time_gated_deferrals_charge_retries(spec, genesis_state):
+    """Entries gated on the CLOCK (far-future target epoch) spend one
+    retry per slot tick — the budget still bounds time-gated spinning."""
+    head, state = _service(spec, genesis_state, defer_retries=2)
+    att = get_valid_attestation(spec, state.copy(), slot=state.slot,
+                                signed=False)
+    att.data.target.epoch = spec.Epoch(64)  # far future: never applies
+    summary = head.on_attestations([att])
+    assert summary["deferred"] == 1
+    _tick_to(spec, head, state.slot + 1)  # retry 1 -> re-defer (charged)
+    assert head.deferred_count == 1
+    _tick_to(spec, head, state.slot + 2)  # retry 2 -> budget exhausted
     assert head.deferred_count == 0
     assert head.metrics.snapshot()["dropped"] == 1
 

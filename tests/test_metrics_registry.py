@@ -22,6 +22,10 @@ _PKG = os.path.join(_ROOT, "consensus_specs_tpu")
 _CALL_RE = re.compile(
     r"profiling\s*\.\s*(?:set_gauge|record_latency|record)\(\s*[\"']([^\"']+)[\"']"
 )
+# node-labelled emission sites: the base name flows through
+# registry.node_label(), which resolves to the bare name or its
+# chain[<node>]./serve[<node>]. form — scan the literal first argument
+_NODE_LABEL_RE = re.compile(r"node_label\(\s*[\"']([^\"']+)[\"']")
 _LABEL_CONST_RE = re.compile(r"^[A-Z_]*LABEL\s*=\s*\"([^\"]+)\"", re.M)
 # whole-family declarations (chain/metrics.py GAUGE_LABELS): a tuple of
 # label strings exported in a loop — scan every quoted member
@@ -44,6 +48,8 @@ def _emitted_labels():
         with open(path) as fh:
             text = fh.read()
         for m in _CALL_RE.finditer(text):
+            labels.setdefault(m.group(1), path)
+        for m in _NODE_LABEL_RE.finditer(text):
             labels.setdefault(m.group(1), path)
         for m in _LABEL_CONST_RE.finditer(text):
             labels.setdefault(m.group(1), path)
@@ -128,6 +134,43 @@ def test_fleet_gauge_families_are_complete():
     dev_src = open(os.path.join(_PKG, "obs", "devices.py")).read()
     assert 'f"device[{lane}]"' in dev_src
     assert "device[" in registry.DYNAMIC_PREFIXES
+
+
+def test_node_labelled_families_registered():
+    # the simnet multi-instance forms: chain[<node>].<name> and
+    # serve[<node>].<name> are registered dynamic families, resolve
+    # through known(), and spell exactly what node_label() emits —
+    # N HeadService/VerificationService instances in one process must
+    # publish side by side, never collide
+    assert "chain[" in registry.DYNAMIC_PREFIXES
+    assert "serve[" in registry.DYNAMIC_PREFIXES
+    for label in ("chain[n0].head_slot", "chain[n3].apply_batch",
+                  "serve[n0].queue_depth", "serve[n1].submit_to_result"):
+        assert registry.known(label), f"{label} not resolvable"
+    # node_label is the one spelling, and both planes route through it
+    assert registry.node_label("chain.head_slot", "n2") == \
+        "chain[n2].head_slot"
+    assert registry.node_label("serve.queue_depth", None) == \
+        "serve.queue_depth"
+    for rel in (("chain", "metrics.py"), ("serve", "metrics.py")):
+        src = open(os.path.join(_PKG, *rel)).read()
+        assert "node_label(" in src, f"{rel} lost its node_label route"
+
+
+def test_node_labelled_bases_cover_the_bare_families():
+    # every label a node-labelled instance can emit must be a registered
+    # BARE name too (the node form only re-scopes it): the scan sees the
+    # node_label("<base>") literals, and each base must be registered
+    emitted = _emitted_labels()
+    node_routed = set()
+    for rel in (("chain", "metrics.py"), ("serve", "metrics.py")):
+        src = open(os.path.join(_PKG, *rel)).read()
+        node_routed.update(_NODE_LABEL_RE.findall(src))
+        node_routed.update(_LABEL_CONST_RE.findall(src))
+    assert node_routed, "node_label scan found no emission sites"
+    for base in node_routed:
+        assert registry.known(base), f"node-labelled base {base} unregistered"
+        assert base in emitted
 
 
 def test_span_stage_registry_matches_tracing_exports():

@@ -34,6 +34,14 @@ margin movement within "met" is reported but never fails (CPU tail
 latencies jitter far more than throughput means; the page-worthy event is
 crossing the objective, and that is exactly what fails).
 
+Simnet gating: rounds that carry a ``sim`` section (`bench.py --mode
+sim` — per-scenario ``converged`` + ``heal_to_convergence_s``) follow
+the same state-not-jitter rule: a scenario that converged in the
+previous round and DIVERGES in the newest fails the gate outright
+(differential convergence is a correctness claim, not a perf number);
+heal-to-convergence latency movement is reported alongside but never
+fails on its own.
+
 Output: the comparison table is also emitted as GitHub-flavored markdown
 — appended to ``$GITHUB_STEP_SUMMARY`` when CI sets it, printed to stdout
 otherwise — so the round-over-round numbers land on the workflow summary
@@ -130,6 +138,31 @@ def extract_slo(doc):
     return out
 
 
+def extract_sim(doc):
+    """{``platform:sim:<scenario>``: {"converged", "heal_s"}} from one
+    round's ``sim`` section (`bench.py --mode sim` scenario matrix)."""
+    parsed = doc.get("parsed")
+    if not isinstance(parsed, dict) or "error" in parsed:
+        return {}
+    section = parsed.get("sim")
+    if not isinstance(section, dict):
+        return {}
+    plat = _platform(parsed)
+    out = {}
+    for name, row in sorted(section.items()):
+        if not isinstance(row, dict):
+            continue
+        try:
+            heal_s = float(row.get("heal_to_convergence_s", 0.0))
+        except (TypeError, ValueError):
+            continue
+        out[f"{plat}:sim:{name}"] = {
+            "converged": bool(row.get("converged", False)),
+            "heal_s": heal_s,
+        }
+    return out
+
+
 def _load(path):
     with open(path) as fh:
         return json.load(fh)
@@ -183,6 +216,7 @@ def main(argv=None) -> int:
         newest_doc = _load(newest)
         new_vals = extract(newest_doc)
         new_slo = extract_slo(newest_doc)
+        new_sim = extract_sim(newest_doc)
     except (OSError, ValueError) as e:
         print(f"bench-compare: FAIL — {os.path.basename(newest)} unreadable: {e}")
         return 1
@@ -196,26 +230,29 @@ def main(argv=None) -> int:
         print("bench-compare: SKIP — only one round; nothing to compare")
         return 0
 
-    prev_vals, prev_slo, prev_path = {}, {}, None
+    prev_vals, prev_slo, prev_sim, prev_path = {}, {}, {}, None
     for path in reversed(files[:-1]):
         try:
             doc = _load(path)
             prev_vals = extract(doc)
             prev_slo = extract_slo(doc)
+            prev_sim = extract_sim(doc)
         except (OSError, ValueError):
-            prev_vals, prev_slo = {}, {}
-        # an SLO-only round (headline errored, objectives still recorded)
-        # is a usable baseline for the SLO gate even with no throughput
-        if prev_vals or prev_slo:
+            prev_vals, prev_slo, prev_sim = {}, {}, {}
+        # an SLO-only or sim-only round (headline errored, objectives or
+        # scenario matrix still recorded) is a usable baseline for its
+        # state gate even with no throughput number
+        if prev_vals or prev_slo or prev_sim:
             prev_path = path
             break
-    if not prev_vals and not prev_slo:
+    if not prev_vals and not prev_slo and not prev_sim:
         print("bench-compare: SKIP — no earlier round recorded a usable value")
         return 0
 
     common = sorted(set(new_vals) & set(prev_vals))
     slo_common = sorted(set(new_slo) & set(prev_slo))
-    if not common and not slo_common:
+    sim_common = sorted(set(new_sim) & set(prev_sim))
+    if not common and not slo_common and not sim_common:
         # SLO keys count as comparables too: two rounds that share no
         # throughput shape but both declare serve_p99 must still gate the
         # objective state, not skip past it
@@ -265,6 +302,26 @@ def main(argv=None) -> int:
         if violated:
             failures.append(key)
 
+    # simnet convergence gate: same state-not-jitter rule as SLO — a
+    # scenario that stops converging is a correctness regression and
+    # fails outright; heal-latency movement is report-only
+    for key in sim_common:
+        old, new = prev_sim[key], new_sim[key]
+        diverged = old["converged"] and not new["converged"]
+        status = "SIM DIVERGED" if diverged else (
+            "ok" if new["converged"] else "still diverged")
+        print(
+            f"  {key}: heal {old['heal_s']:.2f}s -> {new['heal_s']:.2f}s "
+            f"(converged: {old['converged']} -> {new['converged']})"
+            f"{'  ' + status if diverged else ''}"
+        )
+        rows.append((key, f"{old['heal_s']:.2f}s", f"{new['heal_s']:.2f}s",
+                     (new["heal_s"] - old["heal_s"]) / old["heal_s"]
+                     if old["heal_s"] else None,
+                     status))
+        if diverged:
+            failures.append(key)
+
     _emit_markdown(rows, os.path.basename(prev_path),
                    os.path.basename(newest), args.max_regression)
     if failures:
@@ -277,6 +334,8 @@ def main(argv=None) -> int:
         f"bench-compare: OK — {len(common)} comparable key(s) within "
         f"bounds" + (f", {len(slo_common)} SLO key(s) met"
                      if slo_common else "")
+        + (f", {len(sim_common)} sim scenario(s) gated"
+           if sim_common else "")
     )
     return 0
 
