@@ -55,6 +55,17 @@ SERVE_METRICS_PORT=<port|0> additionally serves Prometheus `/metrics` +
 `/snapshot` + `/healthz` (now SLO-state-bearing) + `/flightdump` during
 the run (obs/).
 
+`--mode serve --mesh N` runs the same serve load with the verify plane
+sharded over N virtual CPU devices (CONSENSUS_SPECS_TPU_MESH; the
+micro-batch's Miller loops and RLC chunk ladders ride the mesh batch
+axis, the combine's product folds cross-replica via the Fq12 ppermute
+butterfly, and the flush still pays ONE final exponentiation).
+`--mode serve-mesh` is the scaling sweep: one `--mode serve --mesh d`
+child per device count (SERVE_MESH_DEVICES, default 1,2,4,8), emitting a
+`mesh` section — per-count sigs/sec, per-device occupancy, mesh
+fallbacks, efficiency vs single-device — that tools/bench_compare.py
+gates on ok-state round over round (`make serve-bench-mesh`).
+
 `--mode codec` is the prep-only microbenchmark: the batched input codec
 (ops/codec.py) vs the per-item pure-Python prep path, items/sec over
 CODEC_ITEMS items per kind — no pairings, just the front-door cost.
@@ -439,7 +450,16 @@ def main():
             os.environ["CONSENSUS_SPECS_TPU_FLIGHT"] = "1"
         from consensus_specs_tpu.utils.jax_env import force_cpu
 
-        force_cpu()
+        # `--mesh N` shards the service's verify plane over N virtual CPU
+        # devices (must be requested BEFORE backend init — XLA reads the
+        # host-device-count flag once); the env makes the service's
+        # construction-time mesh provider pick it up
+        mesh_opt = _cli_opt("--mesh")
+        if mesh_opt:
+            os.environ["CONSENSUS_SPECS_TPU_MESH"] = mesh_opt
+            force_cpu(n_devices=max(1, int(mesh_opt)))
+        else:
+            force_cpu()
         from consensus_specs_tpu.serve.load import run_serve_bench
 
         result = run_serve_bench()
@@ -457,6 +477,17 @@ def main():
             result["flight"] = rec.dump(flight_path, reason="bench_flight")
             result["flight_events"] = rec.counters()["events"]
         _emit_result(result)
+        return
+
+    if _cli_mode() == "serve-mesh":
+        # mesh scaling sweep: one serve-bench child per device count (the
+        # virtual-device count is frozen at backend init, so counts can't
+        # share a process); the parent never imports jax. The `mesh`
+        # section is gated round-over-round by tools/bench_compare.py —
+        # a device count that verified and now errors fails the round.
+        from consensus_specs_tpu.serve.load import run_serve_mesh_sweep
+
+        _emit_result(run_serve_mesh_sweep())
         return
 
     if _cli_mode() == "codec":

@@ -45,6 +45,10 @@ GAUGES: Dict[str, str] = {
     "serve.occupancy_rows": "filled batch rows / padded rows (batch axis "
                             "rounds up to a power of two)",
     "serve.occupancy_lanes": "actual committee keys / (rows * K bucket)",
+    "serve.mesh_devices": "devices in the verify plane's mesh (0 = "
+                          "single-device path; CONSENSUS_SPECS_TPU_MESH)",
+    "serve.mesh_fallbacks": "mesh-sharded verify attempts that degraded to "
+                            "the single-device path (ladder rung 0)",
     "bls.prep_pool_broken": "1 when the prewarm process pool has latched "
                             "broken (reset_prep_state() clears)",
     "bls.prep_serial_fallback_items": "items that degraded to serial "

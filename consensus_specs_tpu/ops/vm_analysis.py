@@ -506,6 +506,12 @@ def registry_programs(tier1_only: bool = False) -> List[Tuple[str, str, int, int
     full = [
         ("miller_product", 16, 2),
         ("rlc_combine", 16, 1),
+        # the mesh-sharded combine's per-shard chunk program: under a
+        # mesh the chunk shrinks until every device holds at least one
+        # chunk row (bls_backend._rlc_chunk — e.g. 16 candidates on 4
+        # devices run as chunk-4 rows), so the analyzer's critical-path/
+        # width report must cover the narrow-chunk shape too
+        ("rlc_combine", 4, 1),
         ("hard_part", 0, 8),
         ("g1_subgroup", 0, 4),
         ("g2_subgroup", 0, 8),

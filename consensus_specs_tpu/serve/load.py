@@ -16,9 +16,22 @@ Env overrides (CPU-sized defaults; a granted TPU window can scale up):
   SERVE_MAX_BATCH, SERVE_MAX_WAIT_MS, SERVE_INJECT_FAILURE (1/0),
   SERVE_SEED, SERVE_METRICS_PORT (opt-in /metrics + /snapshot + /healthz
   endpoint during the run; 0 = ephemeral port, reported in the JSON line)
+
+``run_serve_mesh_sweep`` (`bench.py --mode serve-mesh` / `make
+serve-bench-mesh`) runs the same load at several mesh device counts —
+each in a fresh child process (`bench.py --mode serve --mesh <d>`),
+because the virtual-device count is frozen at backend init — and emits
+ONE line whose ``mesh`` section carries per-count sigs/sec, per-device
+occupancy lanes, mesh fallbacks, and the scaling efficiency vs the
+single-device run (report-only on CPU virtual devices; the
+ok-state is what tools/bench_compare.py gates round over round).
+  SERVE_MESH_DEVICES ("1,2,4,8"), SERVE_MESH_TIMEOUT (s/child, 900)
 """
+import json
 import os
 import random
+import subprocess
+import sys
 import time
 from collections.abc import Sequence
 from dataclasses import dataclass
@@ -288,6 +301,40 @@ def run_serve_bench(target: float = TARGET_PER_CHIP) -> dict:
     warmup_s = time.perf_counter() - t0
     assert bool(warm_ok[0]), "serve bench warmup verification failed"
 
+    # with a mesh armed (CONSENSUS_SPECS_TPU_MESH / bench --mesh), pay the
+    # SHARDED executables' compiles outside the timed window too: one
+    # flush-shaped RLC batch of warm-only committees (a different seed, so
+    # none of their content appears in the stream), corrupted last item
+    # included so the bisection path's shapes warm as well
+    from ..utils import jax_env
+
+    warm_mesh = jax_env.maybe_mesh()
+    mesh_warmup_s = 0.0
+    if warm_mesh is not None:
+        t0 = time.perf_counter()
+        warm_items = [
+            ("fast_aggregate", pks, msg, sig)
+            for pks, msg, sig, _ok in build_committees(
+                n_committees, k, seed=seed + 1)
+        ]
+        # flush sizes vary with stream dedup (a full first flush, then
+        # mostly singletons as late committees join), and every size is
+        # its own padded executable — warm the common ones, largest
+        # first so its program/compile work is in place for the rest.
+        # Sizes below the device count warm UNSHARDED: the service
+        # routes such narrow flushes single-device (_flush_mesh), so the
+        # sharded row-padded shapes would never run in-stream
+        import math
+
+        n_dev = math.prod(warm_mesh.shape.values())
+        for size in sorted({len(warm_items), max(1, len(warm_items) // 2),
+                            2, 1}, reverse=True):
+            bls_backend.batch_verify_rlc(
+                warm_items[:size],
+                mesh=warm_mesh if size >= n_dev else None,
+            )
+        mesh_warmup_s = time.perf_counter() - t0
+
     backend = FailingBackendProxy(bls_backend) if inject else bls_backend
     svc = VerificationService(
         backend=backend, max_batch=max_batch, max_wait_ms=max_wait_ms
@@ -431,6 +478,13 @@ def run_serve_bench(target: float = TARGET_PER_CHIP) -> dict:
         slo=slo_section,
         profile=profiling.summary(),
     )
+    if svc.mesh_devices:
+        # the single-run mesh record (per-device-COUNT rows are the sweep
+        # driver's job — run_serve_mesh_sweep assembles its `mesh` section
+        # from one child line per count)
+        result["mesh_devices"] = svc.mesh_devices
+        result["mesh_fallbacks"] = snap["mesh_fallbacks"]
+        result["mesh_warmup_s"] = round(mesh_warmup_s, 3)
     if devices_section is not None:
         result["devices"] = devices_section
     if exposition is not None:
@@ -438,3 +492,109 @@ def run_serve_bench(target: float = TARGET_PER_CHIP) -> dict:
         result["metrics_scrape_ok"] = scrape is not None
         result["metrics_scrape_lines"] = len((scrape or "").splitlines())
     return result
+
+
+# -- mesh scaling sweep (`bench.py --mode serve-mesh`) ------------------------
+
+
+def _parse_last_json_line(stdout: bytes):
+    """Last parseable JSON object in a child's stdout, or None."""
+    parsed = None
+    for line in stdout.decode(errors="replace").strip().splitlines():
+        try:
+            parsed = json.loads(line)
+        except ValueError:
+            continue
+    return parsed
+
+
+def run_serve_mesh_sweep() -> dict:
+    """Serve-plane mesh scaling matrix: one `bench.py --mode serve
+    --mesh <d>` CHILD per device count (the virtual-device count is read
+    once at XLA backend init, so counts cannot share a process), fault
+    injection off (the sweep measures scaling on clean traffic — the
+    degradation ladder has its own bench and tests). Returns bench.py's
+    result dict; the ``mesh`` section maps device count -> {sigs_per_sec,
+    verified_sigs_per_sec, ok, fallbacks, lanes, efficiency}.
+
+    Efficiency (sigs/sec at d devices / (d * single-device sigs/sec)) and
+    the 10%-of-single regression check are REPORT-ONLY on CPU virtual
+    devices (2 host cores timeshare every "device"; true scaling needs
+    real chips) — what bench_compare gates is the ok-STATE: a device
+    count that verified last round and errors now fails the round."""
+    counts = []
+    for tok in os.environ.get("SERVE_MESH_DEVICES", "1,2,4,8").split(","):
+        tok = tok.strip()
+        if tok and tok.isdigit() and int(tok) > 0:
+            counts.append(int(tok))
+    timeout = float(os.environ.get("SERVE_MESH_TIMEOUT", "900"))
+    bench_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), "bench.py")
+
+    rows = {}
+    for d in counts:
+        env = os.environ.copy()
+        env["JAX_PLATFORMS"] = "cpu"
+        # hard assignment, not setdefault: the sweep's contract is clean
+        # traffic — an inherited SERVE_INJECT_FAILURE=1 would make every
+        # child's first sharded flush record a phantom mesh fallback
+        env["SERVE_INJECT_FAILURE"] = "0"
+        cmd = [sys.executable, bench_path, "--mode", "serve",
+               "--mesh", str(d)]
+        try:
+            out = subprocess.run(cmd, capture_output=True, timeout=timeout,
+                                 env=env)
+            parsed = _parse_last_json_line(out.stdout)
+        except subprocess.TimeoutExpired:
+            rows[str(d)] = {"ok": False,
+                            "error": f"child exceeded {timeout:.0f}s"}
+            continue
+        if parsed is None or "error" in parsed or parsed.get("value", 0) <= 0:
+            err = (parsed or {}).get("error") or (
+                out.stderr.decode(errors="replace").strip()
+                .splitlines()[-1:] or ["no parseable output"])[0]
+            rows[str(d)] = {"ok": False, "error": str(err)[:300]}
+            continue
+        lanes = {}
+        for lane, entry in (parsed.get("devices") or {}).get(
+                "lanes", {}).items():
+            lanes[lane] = entry.get("utilization", 0.0)
+        rows[str(d)] = {
+            "ok": True,
+            "sigs_per_sec": round(float(parsed["value"]), 2),
+            "verified_sigs_per_sec": parsed.get("verified_sigs_per_sec", 0.0),
+            "final_exps_per_item": parsed.get("final_exps_per_item", 0.0),
+            "fallbacks": parsed.get("mesh_fallbacks", 0),
+            "p99_ms": parsed.get("p99_ms", 0.0),
+            "lanes": lanes,
+        }
+
+    single = rows.get("1", {})
+    base = single.get("sigs_per_sec", 0.0) if single.get("ok") else 0.0
+    for d_str, row in rows.items():
+        d = int(d_str)
+        if row.get("ok") and base > 0 and d > 1:
+            row["efficiency"] = round(
+                row["sigs_per_sec"] / (d * base), 4)
+            # the CPU acceptance check: sharding must not cost the serve
+            # plane more than 10% of single-device throughput (scaling
+            # itself is report-only until real accelerator rounds)
+            row["within_10pct_of_single"] = bool(
+                row["sigs_per_sec"] >= 0.9 * base)
+
+    ok_rows = {d: r for d, r in rows.items() if r.get("ok")}
+    best = max((r["sigs_per_sec"] for r in ok_rows.values()), default=0.0)
+    best_verified = max(
+        (float(r.get("verified_sigs_per_sec") or 0.0)
+         for r in ok_rows.values()), default=0.0)
+    return dict(
+        metric="sustained aggregate BLS signatures served/sec "
+               "(serve, mesh sweep)",
+        value=best,
+        vs_baseline=best_verified / TARGET_PER_CHIP,
+        platform="cpu",
+        mode="serve-mesh",
+        device_counts=counts,
+        mesh=rows,
+    )

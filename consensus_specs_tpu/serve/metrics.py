@@ -59,6 +59,8 @@ class ServeMetrics:
         self._hit_rate_label = node_label("serve.cache_hit_rate", node)
         self._occ_rows_label = node_label("serve.occupancy_rows", node)
         self._occ_lanes_label = node_label("serve.occupancy_lanes", node)
+        self._mesh_devices_label = node_label("serve.mesh_devices", node)
+        self._mesh_fallbacks_label = node_label("serve.mesh_fallbacks", node)
         self._lock = threading.Lock()
         self.submits = 0
         self.eager = 0  # resolved at submit time by the reference's own rules
@@ -74,6 +76,11 @@ class ServeMetrics:
         self.fallback_batches = 0
         self.fallback_items = 0
         self.queue_depth_peak = 0
+        # mesh plane (ISSUE 9): devices the service's verify mesh spans
+        # (0 = single-device) and how many sharded attempts fell back to
+        # the single-device path (degradation-ladder rung 0)
+        self.mesh_devices = 0
+        self.mesh_fallbacks = 0
         # prep-vs-device time split (the two pipeline stages): where a
         # flush's wall time goes — host codec prep or the device hard
         # part. device_flushes counts whole flushes (like prep_batches)
@@ -140,6 +147,18 @@ class ServeMetrics:
     def note_retry(self) -> None:
         with self._lock:
             self.backend_retries += 1
+
+    def note_mesh(self, n_devices: int) -> None:
+        """Record the verify mesh's device count at service construction."""
+        with self._lock:
+            self.mesh_devices = n_devices
+        profiling.set_gauge(self._mesh_devices_label, n_devices)
+
+    def note_mesh_fallback(self) -> None:
+        with self._lock:
+            self.mesh_fallbacks += 1
+            count = self.mesh_fallbacks
+        profiling.set_gauge(self._mesh_fallbacks_label, count)
 
     def note_fallback(self, n_items: int) -> None:
         with self._lock:
@@ -226,6 +245,8 @@ class ServeMetrics:
                 "backend_retries": self.backend_retries,
                 "fallback_batches": self.fallback_batches,
                 "fallback_items": self.fallback_items,
+                "mesh_devices": self.mesh_devices,
+                "mesh_fallbacks": self.mesh_fallbacks,
                 "queue_depth_peak": self.queue_depth_peak,
                 "prep_batches": self.prep_batches,
                 "device_flushes": self.device_flushes,

@@ -22,10 +22,14 @@ hand-off queue holds at most one prepped batch, so prep can run at most
 one batch ahead (caches stay bounded, backpressure still propagates to
 submit()).
 
-Robustness: a device error on a batch is retried once (transient), then
-the whole group degrades to the pure-Python oracle sequentially — a
-poisoned batch costs latency, never stream correctness, and never a lost
-request. Duplicate content (the same aggregate from many gossip peers) is
+Robustness: with a device mesh armed (CONSENSUS_SPECS_TPU_MESH, resolved
+at construction via utils/jax_env.get_mesh) the flush's verification is
+sharded over the mesh batch axis first; a mesh failure degrades to the
+single-device path (rung 0). From there a device error on a batch is
+retried once (transient), then the whole group degrades to the
+pure-Python oracle sequentially — a poisoned batch costs latency, never
+stream correctness, and never a lost request. Duplicate content (the
+same aggregate from many gossip peers) is
 answered by the result LRU or, while still in flight, by sharing the
 first submitter's Future (`cache.py`) — the backend sees each distinct
 check exactly once.
@@ -120,9 +124,32 @@ class VerificationService:
     def __init__(self, backend=None, oracle=None, *, max_batch: int = 256,
                  max_wait_ms: float = 20.0, max_queue: int = 4096,
                  cache_capacity: int = 1 << 16, backend_retries: int = 1,
-                 bucket_fn=None, tracer=None, node=None):
+                 bucket_fn=None, tracer=None, node=None, mesh=None):
         assert max_batch > 0 and max_queue > 0
         self._backend = backend  # None: resolved lazily on first batch
+        # verify-plane device mesh (ISSUE 9): acquired HERE, at
+        # construction — an explicit ``mesh=`` wins, otherwise the
+        # process-level provider (utils/jax_env.get_mesh, governed by
+        # CONSENSUS_SPECS_TPU_MESH; one env read and no jax import when
+        # off). Threaded through every backend call; a sharded attempt
+        # that fails degrades to the single-device path (ladder rung 0,
+        # serve.mesh_fallbacks + a degraded_mesh_to_single flight event).
+        if mesh is None:
+            from ..utils import jax_env
+
+            mesh = jax_env.maybe_mesh()
+        self._mesh = mesh
+        self._mesh_devices = 0
+        if mesh is not None:
+            import math
+
+            try:
+                self._mesh_devices = math.prod(mesh.shape.values())
+            except Exception:
+                self._mesh_devices = 0
+            if self._mesh_devices <= 1:
+                self._mesh = None  # a 1-device mesh is the unsharded path
+                self._mesh_devices = 0
         # per-request span tracing (obs/tracing.py): an explicit tracer
         # wins; otherwise the global tracer iff CONSENSUS_SPECS_TPU_TRACE
         # is set AT CONSTRUCTION. Disabled == None: every stage guards on
@@ -161,6 +188,7 @@ class VerificationService:
         # node labels the whole metric family (serve[<node>].<name>) so N
         # instances — one per simnet node — coexist in one process
         self.metrics = ServeMetrics(node=node)
+        self.metrics.note_mesh(self._mesh_devices)
         self._closed = False
         # two-stage pipeline: prep(N+1) overlaps device(N) through a
         # one-slot hand-off queue
@@ -294,6 +322,22 @@ class VerificationService:
     @property
     def cache(self) -> ResultCache:
         return self._cache
+
+    @property
+    def mesh_devices(self) -> int:
+        """Devices the verify mesh spans (0 = single-device path)."""
+        return self._mesh_devices
+
+    def _flush_mesh(self, n_items: int):
+        """The mesh for an n_items flush — None when the batch is
+        narrower than the device count: the batch rows pad up to the
+        mesh, so sharding such a flush runs mostly-filler rows on every
+        device (pure waste on CPU, pure idle on real chips) while the
+        single-device executables are already warm. Verdicts are
+        identical either way; this only picks the cheaper layout."""
+        if self._mesh is not None and n_items >= self._mesh_devices:
+            return self._mesh
+        return None
 
     # -- worker -------------------------------------------------------------
 
@@ -464,6 +508,27 @@ class VerificationService:
         if rlc_fn is None or not _rlc_enabled():
             return None
         items = [(p.kind, p.pubkeys, p.messages, p.signature) for p in batch]
+        flush_mesh = self._flush_mesh(len(batch))
+        if flush_mesh is not None:
+            # degradation-ladder rung 0: the mesh-sharded combined check.
+            # A failure here (shard_map compile error, a device dropping
+            # out of the mesh) must cost one fallback, never the flush —
+            # the single-device RLC below still amortizes the final exp.
+            try:
+                t0 = time.perf_counter()
+                res = [bool(r) for r in rlc_fn(items, mesh=flush_mesh)]
+                if self._tracer is not None:
+                    self._tracer.span_many((p.trace for p in batch),
+                                           "combine", t0,
+                                           time.perf_counter())
+                return res
+            except Exception as e:
+                self.metrics.note_mesh_fallback()
+                if self._flight is not None:
+                    self._flight.note(
+                        "serve", "degraded_mesh_to_single",
+                        items=len(batch), devices=self._mesh_devices,
+                        error=f"{type(e).__name__}: {e}"[:200])
         for attempt in range(1 + self._backend_retries):
             if attempt:
                 self.metrics.note_retry()
@@ -502,22 +567,41 @@ class VerificationService:
                     self._flight.note("serve", "backend_retry",
                                       stage="group", attempt=attempt,
                                       check_kind=kind, items=len(pends))
+            # attempt 0 rides the mesh when one is armed (and the group
+            # is at least mesh-wide); retries drop to the single-device
+            # path so a mesh-specific fault degrades in one rung instead
+            # of burning the whole retry budget sharded
+            kwargs = {}
+            group_mesh = self._flush_mesh(len(pends)) if attempt == 0 else None
+            if group_mesh is not None:
+                kwargs["mesh"] = group_mesh
             try:
                 if kind == "fast_aggregate":
                     res = backend.batch_fast_aggregate_verify(
                         [p.pubkeys for p in pends],
                         [p.messages for p in pends],
                         [p.signature for p in pends],
+                        **kwargs,
                     )
                 else:
                     res = backend.batch_aggregate_verify(
                         [p.pubkeys for p in pends],
                         [p.messages for p in pends],
                         [p.signature for p in pends],
+                        **kwargs,
                     )
                 return [bool(r) for r in res]
             except Exception as e:  # device/compile/transfer failure
                 last_err = e
+                if kwargs:
+                    self.metrics.note_mesh_fallback()
+                    if self._flight is not None:
+                        self._flight.note(
+                            "serve", "degraded_mesh_to_single",
+                            stage="group", check_kind=kind,
+                            items=len(pends),
+                            devices=self._mesh_devices,
+                            error=f"{type(e).__name__}: {e}"[:200])
         # poisoned batch: degrade to sequential oracle verification —
         # the stream slows down, it does not fail
         profiling.record("serve.backend_error", 0.0)

@@ -316,6 +316,91 @@ def test_sim_extract_shapes(bc):
     assert bc.extract_sim({"parsed": _parsed(300.0)}) == {}
 
 
+def _mesh_parsed(value, counts, **extra):
+    """A `--mode serve-mesh` line: ``counts`` maps device count (str) ->
+    (ok, sigs_per_sec) or (ok, sigs_per_sec, efficiency)."""
+    mesh = {}
+    for name, row in counts.items():
+        ok, sigs = row[0], row[1]
+        entry = {"ok": ok}
+        if ok:
+            entry["sigs_per_sec"] = sigs
+            if len(row) > 2:
+                entry["efficiency"] = row[2]
+        else:
+            entry["error"] = "child exceeded 900s"
+        mesh[name] = entry
+    return _parsed(value, mode="serve-mesh", n=None, k=None, mesh=mesh,
+                   **extra)
+
+
+def test_mesh_newly_erroring_device_count_fails(tmp_path, bc, capsys):
+    """The mesh gate (ISSUE 9): a device count that verified last round
+    and errors in the newest fails outright — losing a working mesh size
+    is an availability regression, not perf jitter."""
+    _write_round(tmp_path, 1, _mesh_parsed(
+        2000.0, {"1": (True, 2000.0), "4": (True, 1900.0, 0.24)}))
+    _write_round(tmp_path, 2, _mesh_parsed(
+        2000.0, {"1": (True, 2000.0), "4": (False, 0.0)}))
+    assert bc.main(["--dir", str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "cpu:mesh:4" in out and "MESH ERRORED" in out
+
+
+def test_mesh_throughput_and_efficiency_are_report_only(tmp_path, bc,
+                                                        capsys):
+    """Per-count sigs/sec and scaling efficiency never fail on their own
+    (CPU virtual devices timeshare two host cores — the numbers carry no
+    scaling signal until real accelerator rounds)."""
+    _write_round(tmp_path, 1, _mesh_parsed(
+        2000.0, {"1": (True, 2000.0), "4": (True, 1900.0, 0.24)}))
+    _write_round(tmp_path, 2, _mesh_parsed(
+        2000.0, {"1": (True, 2000.0), "4": (True, 400.0, 0.05)}))  # -79%
+    assert bc.main(["--dir", str(tmp_path)]) == 0
+    assert "cpu:mesh:4" in capsys.readouterr().out
+
+
+def test_mesh_still_erroring_is_not_a_new_failure(tmp_path, bc):
+    """ok False -> False: the round that lost the device count already
+    failed once; a permanently-broken count must not wedge every round."""
+    _write_round(tmp_path, 1, _mesh_parsed(
+        2000.0, {"1": (True, 2000.0), "8": (False, 0.0)}))
+    _write_round(tmp_path, 2, _mesh_parsed(
+        2000.0, {"1": (True, 2000.0), "8": (False, 0.0)}))
+    assert bc.main(["--dir", str(tmp_path)]) == 0
+
+
+def test_mesh_keys_join_without_common_throughput_keys(tmp_path, bc,
+                                                       capsys):
+    """Shared mesh keys are comparables in their own right (the SLO/sim
+    rule): disjoint throughput shapes must still gate an ok -> error
+    transition instead of skipping."""
+    _write_round(tmp_path, 1, _parsed(
+        1000.0, mode="head", n=None, k=None, blocks=1024,
+        mesh={"2": {"ok": True, "sigs_per_sec": 1500.0}}))
+    _write_round(tmp_path, 2, _parsed(
+        900.0, mode="head", n=None, k=None, blocks=128,
+        mesh={"2": {"ok": False, "error": "shard_map compile"}}))
+    assert bc.main(["--dir", str(tmp_path)]) == 1
+    assert "MESH ERRORED" in capsys.readouterr().out
+
+
+def test_mesh_extract_shapes(bc):
+    doc = {"parsed": _mesh_parsed(
+        2000.0, {"1": (True, 2000.0), "2": (True, 1500.0, 0.375)})}
+    assert bc.extract_mesh(doc) == {
+        "cpu:mesh:1": {"ok": True, "sigs_per_sec": 2000.0,
+                       "efficiency": None},
+        "cpu:mesh:2": {"ok": True, "sigs_per_sec": 1500.0,
+                       "efficiency": 0.375},
+    }
+    # single `--mesh N` serve lines (flat mesh_devices field, no `mesh`
+    # per-count section) and error rounds extract nothing
+    assert bc.extract_mesh({"parsed": _parsed(
+        300.0, mode="serve", n=None, k=None, mesh_devices=4)}) == {}
+    assert bc.extract_mesh({"parsed": {"error": "boom"}}) == {}
+
+
 def test_markdown_table_written_to_github_step_summary(tmp_path, bc,
                                                       monkeypatch):
     summary_file = tmp_path / "summary.md"

@@ -42,6 +42,15 @@ previous round and DIVERGES in the newest fails the gate outright
 heal-to-convergence latency movement is reported alongside but never
 fails on its own.
 
+Mesh gating: rounds that carry a ``mesh`` section (`bench.py --mode
+serve-mesh` — per-device-count serve rows) gate on the same state rule:
+a device count that VERIFIED in the previous round and ERRORS in the
+newest fails the round outright (losing a working mesh size is a
+correctness/availability regression), while per-count sigs/sec and the
+scaling-efficiency ratio are report-only — CPU virtual devices
+timeshare two host cores, so their scaling numbers say nothing until
+real accelerator rounds.
+
 Output: the comparison table is also emitted as GitHub-flavored markdown
 — appended to ``$GITHUB_STEP_SUMMARY`` when CI sets it, printed to stdout
 otherwise — so the round-over-round numbers land on the workflow summary
@@ -163,6 +172,34 @@ def extract_sim(doc):
     return out
 
 
+def extract_mesh(doc):
+    """{``platform:mesh:<devices>``: {"ok", "sigs_per_sec", "efficiency"}}
+    from one round's ``mesh`` section (`bench.py --mode serve-mesh`
+    per-device-count rows; single `--mesh N` serve lines carry flat
+    ``mesh_devices``/``mesh_fallbacks`` fields instead and are skipped)."""
+    parsed = doc.get("parsed")
+    if not isinstance(parsed, dict) or "error" in parsed:
+        return {}
+    section = parsed.get("mesh")
+    if not isinstance(section, dict):
+        return {}
+    plat = _platform(parsed)
+    out = {}
+    for name, row in sorted(section.items()):
+        if not isinstance(row, dict) or "ok" not in row:
+            continue
+        try:
+            sigs = float(row.get("sigs_per_sec") or 0.0)
+        except (TypeError, ValueError):
+            sigs = 0.0
+        out[f"{plat}:mesh:{name}"] = {
+            "ok": bool(row.get("ok", False)),
+            "sigs_per_sec": sigs,
+            "efficiency": row.get("efficiency"),
+        }
+    return out
+
+
 def _load(path):
     with open(path) as fh:
         return json.load(fh)
@@ -217,6 +254,7 @@ def main(argv=None) -> int:
         new_vals = extract(newest_doc)
         new_slo = extract_slo(newest_doc)
         new_sim = extract_sim(newest_doc)
+        new_mesh = extract_mesh(newest_doc)
     except (OSError, ValueError) as e:
         print(f"bench-compare: FAIL — {os.path.basename(newest)} unreadable: {e}")
         return 1
@@ -230,29 +268,31 @@ def main(argv=None) -> int:
         print("bench-compare: SKIP — only one round; nothing to compare")
         return 0
 
-    prev_vals, prev_slo, prev_sim, prev_path = {}, {}, {}, None
+    prev_vals, prev_slo, prev_sim, prev_mesh, prev_path = {}, {}, {}, {}, None
     for path in reversed(files[:-1]):
         try:
             doc = _load(path)
             prev_vals = extract(doc)
             prev_slo = extract_slo(doc)
             prev_sim = extract_sim(doc)
+            prev_mesh = extract_mesh(doc)
         except (OSError, ValueError):
-            prev_vals, prev_slo, prev_sim = {}, {}, {}
+            prev_vals, prev_slo, prev_sim, prev_mesh = {}, {}, {}, {}
         # an SLO-only or sim-only round (headline errored, objectives or
         # scenario matrix still recorded) is a usable baseline for its
         # state gate even with no throughput number
-        if prev_vals or prev_slo or prev_sim:
+        if prev_vals or prev_slo or prev_sim or prev_mesh:
             prev_path = path
             break
-    if not prev_vals and not prev_slo and not prev_sim:
+    if not prev_vals and not prev_slo and not prev_sim and not prev_mesh:
         print("bench-compare: SKIP — no earlier round recorded a usable value")
         return 0
 
     common = sorted(set(new_vals) & set(prev_vals))
     slo_common = sorted(set(new_slo) & set(prev_slo))
     sim_common = sorted(set(new_sim) & set(prev_sim))
-    if not common and not slo_common and not sim_common:
+    mesh_common = sorted(set(new_mesh) & set(prev_mesh))
+    if not common and not slo_common and not sim_common and not mesh_common:
         # SLO keys count as comparables too: two rounds that share no
         # throughput shape but both declare serve_p99 must still gate the
         # objective state, not skip past it
@@ -322,6 +362,30 @@ def main(argv=None) -> int:
         if diverged:
             failures.append(key)
 
+    # mesh state gate: a device count that verified last round and errors
+    # now fails outright; sigs/sec + efficiency at each count are
+    # report-only (CPU virtual devices cannot demonstrate real scaling)
+    for key in mesh_common:
+        old, new = prev_mesh[key], new_mesh[key]
+        broke = old["ok"] and not new["ok"]
+        status = "MESH ERRORED" if broke else (
+            "ok" if new["ok"] else "still erroring")
+        eff = new.get("efficiency")
+        eff_s = f", efficiency {eff:.2f}" if isinstance(eff, float) else ""
+        print(
+            f"  {key}: {old['sigs_per_sec']:.2f} -> "
+            f"{new['sigs_per_sec']:.2f} sigs/sec (ok: {old['ok']} -> "
+            f"{new['ok']}{eff_s}){'  ' + status if broke else ''}"
+        )
+        rows.append((key, f"{old['sigs_per_sec']:.2f}",
+                     f"{new['sigs_per_sec']:.2f}",
+                     (new["sigs_per_sec"] - old["sigs_per_sec"])
+                     / old["sigs_per_sec"]
+                     if old["sigs_per_sec"] else None,
+                     status))
+        if broke:
+            failures.append(key)
+
     _emit_markdown(rows, os.path.basename(prev_path),
                    os.path.basename(newest), args.max_regression)
     if failures:
@@ -336,6 +400,8 @@ def main(argv=None) -> int:
                      if slo_common else "")
         + (f", {len(sim_common)} sim scenario(s) gated"
            if sim_common else "")
+        + (f", {len(mesh_common)} mesh device count(s) gated"
+           if mesh_common else "")
     )
     return 0
 
