@@ -19,7 +19,8 @@ CHEAP_GENERATORS = shuffling bls ssz_generic merkle
 .PHONY: test citest test_tpu_backend lint vmlint vm-cache-prune generate_tests \
         detect_generator_incomplete check_vectors bench serve-bench codec-bench multichip \
         clean_vectors generate_random_tests bench-compare check serve-trace head-bench docs \
-        sim-bench sim-smoke serve-bench-mesh mesh-smoke clean
+        sim-bench sim-smoke serve-bench-mesh mesh-smoke clean rlc-bench \
+        finalexp-bench finalexp-smoke native sweep
 
 # fast default: BLS stubbed except @always_bls, 4-way process-parallel
 # (reference `make test` = pytest -n 4, reference Makefile:100)
@@ -195,6 +196,28 @@ sim-smoke:
 rlc-bench:
 	JAX_PLATFORMS=cpu python bench.py --mode rlc
 
+# hard-part variant race (ISSUE 10): host-oracle HHT vs the VM variants
+# (bit_serial legacy chain, windowed, frobenius) at pipelined rows
+# {1,2,4,8} on identical valid unitary inputs, ms/row per cell, plus the
+# vmlint critical-path ratios (the >=2.5x depth bar) and the bucketed-vs-
+# legacy assembler throughput race on the chunk-16 rlc_combine (the >=4x
+# / <=2s bars). `finalexp[variant,rows]` cells are state-gated round over
+# round by tools/bench_compare.py — an errored variant fails the round,
+# a device route merely slower than host is report-only
+finalexp-bench:
+	JAX_PLATFORMS=cpu python bench.py --mode finalexp
+
+# hard-part bit-identity canary (CI, mirror of mesh-smoke): the windowed
+# and Frobenius hard-part programs held to full-coefficient identity
+# against the exact-int host oracle over valid AND adversarial Fq12
+# inputs (identity, random unitary, conjugates, real valid/corrupted
+# verification flows, raw non-unitary feeds under the no-false-accept
+# contract); dumps the flight journal to finalexp_flight.jsonl on
+# failure — uploaded as a CI artifact. Kept out of tier-1 (three
+# hard-part XLA compiles)
+finalexp-smoke:
+	JAX_PLATFORMS=cpu python -m consensus_specs_tpu.ops.finalexp_smoke
+
 multichip:
 	python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('multichip OK')"
 
@@ -206,11 +229,14 @@ clean_vectors:
 # tree reproducible after `make serve-trace` / `sim-bench` / `mesh-smoke`)
 clean:
 	rm -rf serve_trace.json serve_flight.jsonl flight_dump.jsonl \
-		mesh_flight.jsonl sim_flight/
+		mesh_flight.jsonl finalexp_flight.jsonl sim_flight/
 
-# build the native batched-SHA256 merkleization kernel (csrc/)
+# build the native kernels (csrc/): batched-SHA256 merkleization and the
+# VM assembler's scheduling+allocation kernel (ops/vm.py loads it via
+# ctypes when present; the pure-Python bucketed scheduler is the fallback)
 native:
 	gcc -O3 -fPIC -shared -o csrc/libsha256_batch.so csrc/sha256_batch.c
+	gcc -O3 -fPIC -shared -o csrc/libvmsched.so csrc/vm_sched.c
 
 # regenerate the human-readable per-fork spec document set from specsrc/
 docs:

@@ -528,6 +528,22 @@ def main():
         _emit_result(run_sim_bench())
         return
 
+    if _cli_mode() == "finalexp":
+        # hard-part microbench (ISSUE 10): host-oracle HHT vs the VM
+        # hard-part variants (bit_serial, windowed, frobenius) at
+        # pipelined rows {1,2,4,8}, plus the vmlint critical-path ratios
+        # and the bucketed-vs-legacy assembler race on the chunk-16
+        # rlc_combine. CPU-forced; the `finalexp` section is state-gated
+        # round over round by tools/bench_compare.py (an errored variant
+        # fails the round; a device cell slower than host is report-only)
+        from consensus_specs_tpu.utils.jax_env import force_cpu
+
+        force_cpu()
+        from consensus_specs_tpu.bench.finalexp import run_finalexp_bench
+
+        _emit_result(run_finalexp_bench())
+        return
+
     if _cli_mode() == "rlc":
         # final-exp microbench: per-item easy+hard vs the RLC combine on
         # identical Miller outputs, items/sec across N in {4,16,64,256}.

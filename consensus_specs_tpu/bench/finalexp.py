@@ -1,0 +1,197 @@
+"""Final-exponentiation hard-part microbenchmark (`make finalexp-bench`).
+
+Races the host-oracle HHT against every VM hard-part variant on identical
+unitary rows, at rows in {1, 2, 4, 8} (FINALEXP_ROWS):
+
+  host        exact-int oracle HHT, one element at a time (~20 ms/row on
+              CPU — the route `CONSENSUS_SPECS_TPU_RLC_FINAL=auto` picks
+              there);
+  bit_serial  the legacy depth-bound chain (4864 padded steps at any
+              fold — ISSUE 10's "~1.3 s/row" motivation);
+  windowed    HHT with sliding-window ladders over depth-lean component
+              cyclotomic squarings (crit ~2109);
+  frobenius   the lambda-decomposed spine variant (crit ~1840, the
+              width-for-depth flagship) — rows >= 2 fold onto the program
+              row, so ms/row drops with pipelining.
+
+Every VM execution's verdict must be True on the valid rows (an errored
+or wrong-verdict variant marks its cells ok=false — tools/bench_compare.py
+fails the round on a variant that worked last round, mirror of MESH
+ERRORED; a device cell merely slower than host is report-only).
+
+The JSON line also carries:
+  crit_path   vmlint critical-path depths per variant + the ratio vs the
+              legacy 4864-step chain (the >=2.5x acceptance bar);
+  assembler   the bucketed-vs-legacy scheduler race on the chunk-16
+              rlc_combine (ops/sec both ways, cold-assembly seconds, the
+              >=4x / <=2s acceptance bars, whether the native kernel ran);
+  bars        every ISSUE 10 acceptance predicate, pre-evaluated.
+
+Env: FINALEXP_ROWS (default "1,2,4,8"), FINALEXP_REPS (default 1),
+FINALEXP_SEED (default 7).
+"""
+import os
+import time
+
+import numpy as np
+
+
+def _timed(fn) -> float:
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
+def _build_g_rows(seed: int, n: int) -> "tuple":
+    """(n, 12, L) Montgomery rows of VALID unitary hard-part inputs (post
+    easy part of real verification f's) + their exact flat coefficients.
+    Valid rows make every variant's verdict True, so a wrong formula is an
+    immediate ok=false, not a silent slow cell."""
+    from ..ops import bls_backend as bb, fq
+    from .rlc_final import _build_f_rows
+
+    fs = _build_f_rows(seed)
+    rows = []
+    coeffs = []
+    for i in range(n):
+        f = [fq.from_mont_limbs(fs[i % fs.shape[0], j]) for j in range(12)]
+        g = bb._easy_part_flat(f)
+        assert g is not None
+        coeffs.append(g)
+        rows.append(np.stack([fq.to_mont_int(c) for c in g]))
+    return np.stack(rows), coeffs
+
+
+def run_finalexp_bench() -> dict:
+    from ..ops import bls_backend as bb, vm_analysis, vmlib
+
+    rows_list = [
+        int(x)
+        for x in os.environ.get("FINALEXP_ROWS", "1,2,4,8").split(",")
+    ]
+    reps = max(1, int(os.environ.get("FINALEXP_REPS", "1")))
+    seed = int(os.environ.get("FINALEXP_SEED", "7"))
+
+    max_rows = max(rows_list)
+    g_rows, g_coeffs = _build_g_rows(seed, max_rows)
+
+    section = {}
+
+    def put(variant, rows, ms, ok=True, err=None):
+        cell = {"ok": bool(ok), "ms_per_row": round(ms / rows, 2) if ms else None}
+        if err:
+            cell["error"] = str(err)[:200]
+        section[f"{variant},{rows}"] = cell
+
+    # host oracle: one exact-int HHT per row
+    for r in rows_list:
+        def host_all():
+            for c in g_coeffs[:r]:
+                assert bb._hard_part_is_one_oracle(c)
+        host_all()  # warm (pure python; also validates)
+        dt = min(_timed(host_all) for _ in range(reps))
+        put("host", r, dt * 1e3)
+
+    # the one canonical variant-name -> program-kind map (bls_backend owns
+    # routing; the bench races exactly what production can serve)
+    variants = dict(bb._HARD_PART_KINDS)
+    for variant, kind in variants.items():
+        for r in rows_list:
+            sub = g_rows[:r]
+            try:
+                ok = bb._run_hard_part(sub, kind=kind)  # warm + verdict
+                if not ok.all():
+                    put(variant, r, 0.0, ok=False,
+                        err="wrong verdict on valid rows")
+                    continue
+                dt = min(
+                    _timed(lambda: bb._run_hard_part(sub, kind=kind))
+                    for _ in range(reps)
+                )
+                put(variant, r, dt * 1e3)
+            except Exception as e:
+                put(variant, r, 0.0, ok=False, err=f"{type(e).__name__}: {e}")
+
+    # vmlint critical paths (fold-1 shapes), vs the legacy padded chain
+    legacy_padded = 4864
+    crit = {}
+    for variant, kind in variants.items():
+        rep = vm_analysis.analyze_prog(
+            vmlib.BUILDERS[kind](0, 1), name=kind,
+            w_mul=bb.W_MUL, w_lin=bb.W_LIN,
+            pad_steps_to=bb.PAD_STEPS, pad_regs_to=bb._pow2(64))
+        crit[variant] = rep["cost"]["critical_path"]
+    best_crit = min(crit["windowed"], crit["frobenius"])
+    crit_section = dict(crit, legacy_padded=legacy_padded,
+                        best_ratio=round(legacy_padded / best_crit, 2))
+
+    # assembler race: bucketed (+ native kernel when built) vs legacy list
+    # scheduling on the chunk-16 rlc_combine — the .vm_cache-miss stall
+    from ..ops import vm as vm_mod
+
+    prog = vmlib.build_rlc_combine(16, 1)
+    n_ops = len(prog.ops)
+    shape = dict(w_mul=bb.W_MUL, w_lin=bb.W_LIN,
+                 pad_steps_to=bb.PAD_STEPS, pad_regs_to=bb._pow2(64))
+    new_s = min(
+        _timed(lambda: prog.assemble(annotate=False, **shape))
+        for _ in range(2)
+    )
+    legacy_s = _timed(lambda: prog.assemble_legacy(**shape))
+    assembler = {
+        "ops": n_ops,
+        "new_s": round(new_s, 3),
+        "legacy_s": round(legacy_s, 3),
+        "new_ops_per_s": round(n_ops / new_s, 0),
+        "legacy_ops_per_s": round(n_ops / legacy_s, 0),
+        "speedup": round(legacy_s / new_s, 2),
+        "native_kernel": vm_mod._NATIVE_SCHED is not None,
+    }
+
+    # acceptance predicates (ISSUE 10)
+    def ms(variant, r):
+        cell = section.get(f"{variant},{r}")
+        return cell["ms_per_row"] if cell and cell["ok"] else None
+
+    base_1row = ms("bit_serial", 1)
+    pipelined = [
+        ms(v, r)
+        for v in ("windowed", "frobenius")
+        for r in rows_list
+        if r >= 2 and ms(v, r)
+    ]
+    best_pipelined = min(pipelined) if pipelined else None
+    bars = {
+        "depth_2_5x": legacy_padded >= 2.5 * best_crit,
+        "ms_per_row_3x": bool(
+            base_1row and best_pipelined
+            and base_1row >= 3.0 * best_pipelined),
+        "assembler_4x": assembler["speedup"] >= 4.0,
+        "cold_assembly_2s": new_s <= 2.0,
+    }
+
+    best_rows = max(
+        (r for r in rows_list
+         if any(ms(v, r) for v in ("windowed", "frobenius"))),
+        default=max_rows)
+    best_ms = min(
+        (ms(v, best_rows) for v in ("windowed", "frobenius")
+         if ms(v, best_rows)),
+        default=None)
+    value = 1e3 / best_ms if best_ms else 0.0  # rows/sec, higher-better
+    return dict(
+        metric="hard-part finalization rows/sec (best VM variant, "
+               f"{best_rows} pipelined rows)",
+        value=round(value, 2),
+        vs_baseline=round(
+            (base_1row / best_pipelined) / 3.0, 3
+        ) if (base_1row and best_pipelined) else 0.0,
+        mode="finalexp",
+        rows=rows_list,
+        reps=reps,
+        final=bb._rlc_final_mode(),
+        finalexp=section,
+        crit_path=crit_section,
+        assembler=assembler,
+        bars=bars,
+    )

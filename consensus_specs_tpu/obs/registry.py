@@ -58,6 +58,10 @@ GAUGES: Dict[str, str] = {
                           "split",
     "bls.final_exps": "final exponentiations paid (device rows incl. "
                       "padding + host-oracle hard parts)",
+    "bls.final_exp_rows_inflight": "hard-part rows the last device "
+                                   "finalization window coalesced (>= 2 "
+                                   "means concurrent flushes pipelined "
+                                   "one VM execution)",
     "bls.vm_cache_hits": "assembled VM programs served from the .vm_cache/ "
                          "disk cache this process",
     "bls.vm_cache_misses": "VM programs that had to pay host assembly "

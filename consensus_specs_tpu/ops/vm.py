@@ -44,6 +44,72 @@ _B_CAP = 1 << 420  # register capacity (15 x 28-bit limbs)
 _MUL, _ADD, _SUB = 0, 1, 2
 
 
+def _load_native_sched():
+    """ctypes handle to the native scheduling+allocation kernel
+    (csrc/vm_sched.c, built by `make native`), or None — the pure-Python
+    bucketed scheduler below is the always-available fallback and the two
+    are gated bit-identical (tests/test_vm_scheduler.py)."""
+    import ctypes
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))),
+        "csrc", "libvmsched.so",
+    )
+    try:
+        lib = ctypes.CDLL(path)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        lib.vm_schedule_alloc.restype = ctypes.c_int
+        lib.vm_schedule_alloc.argtypes = [
+            ctypes.c_int64, i64p, i64p, i64p,  # n, kind, a, b
+            ctypes.c_int64, ctypes.c_int64,    # w_mul, w_lin
+            ctypes.c_int64, i64p,              # n_out, outs
+            i64p, i64p, i64p, i64p,            # step, last_use, reg, meta
+        ]
+        return lib
+    except (OSError, AttributeError):
+        # absent .so, or a stale/foreign one without the expected symbol:
+        # fall back to the pure-Python scheduler, never fail import
+        return None
+
+
+_NATIVE_SCHED = _load_native_sched()
+
+
+def _native_schedule_alloc(kind_arr, a_all, b_all, w_mul, w_lin, outputs):
+    """Run the native kernel over sanitized int64 IR columns. Returns
+    (step, last_use, reg, n_steps, alloc_regs) or None on any failure
+    (the caller falls back to the Python loops)."""
+    if _NATIVE_SCHED is None:
+        return None
+    import ctypes
+
+    n = kind_arr.size
+    step = np.empty(n, dtype=np.int64)
+    last_use = np.empty(n, dtype=np.int64)
+    reg = np.full(n, -1, dtype=np.int64)
+    meta = np.zeros(2, dtype=np.int64)
+    outs = np.asarray(outputs, dtype=np.int64)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+
+    def p(arr):
+        return arr.ctypes.data_as(i64p)
+
+    # keep every buffer bound to a local for the duration of the call —
+    # all inputs are freshly built C-contiguous int64 arrays
+    try:
+        rc = _NATIVE_SCHED.vm_schedule_alloc(
+            n, p(kind_arr), p(a_all), p(b_all),
+            w_mul, w_lin, outs.size, p(outs),
+            p(step), p(last_use), p(reg), p(meta),
+        )
+    except Exception:
+        return None
+    if rc != 0:
+        return None
+    return step, last_use, reg, int(meta[0]), int(meta[1])
+
+
 @dataclass
 class _Op:
     kind: int  # _MUL/_ADD/_SUB
@@ -211,10 +277,296 @@ class Prog:
         w_lin: int = 128,
         pad_steps_to: int = 1,
         pad_regs_to: int = 1,
+        annotate: bool = True,
     ) -> "Program":
-        """Schedule + allocate. `pad_steps_to`/`pad_regs_to` round the step
-        count and register-file size up to multiples/sizes so distinct
-        programs share XLA executables (compile cost is per shape bucket)."""
+        """Schedule + allocate with the BUCKETED incremental scheduler.
+
+        Placement rule (identical to the legacy list scheduler, gated
+        bit-exact by tests/test_vm_scheduler.py): each ALU op lands on the
+        first step >= max(operand steps) + 1 whose unit has a free lane,
+        lanes filled in op-creation order. The legacy implementation
+        re-SCANNED the fill array from `earliest` for every op — O(n x
+        schedule length) on deep programs, the measured ~250k ops/sec that
+        made every .vm_cache miss a 6-8 s stall. Here each unit keeps a
+        union-find "next step with free capacity" forest (full steps point
+        past themselves; finds path-compress), so placement is amortized
+        O(alpha) per op, and liveness + instruction-tensor emission are
+        numpy-vectorized — ~1M+ ops/sec end to end.
+
+        `pad_steps_to`/`pad_regs_to` round the step count and register-file
+        size up so distinct programs share XLA executables (compile cost is
+        per shape bucket). ``annotate`` writes step/last-use/reg back onto
+        the IR ops (vm_analysis reads them); the production program cache
+        skips it (`annotate=False`) — attribute writes on a million-op IR
+        are a measurable slice of the assembly budget.
+        """
+        ops = self.ops
+        n = len(ops)
+        kind_l = [op.kind for op in ops]
+        a_l = [op.a for op in ops]
+        b_l = [op.b for op in ops]
+        # operand columns are numpy-castable once the const payloads
+        # (arbitrary-size field ints stashed in ``a``) are masked out —
+        # there are only a handful of const ops per program
+        if self.consts:
+            a_l_safe = a_l[:]  # local copy: never mutate the IR
+            for ci in self.consts.values():
+                a_l_safe[ci] = 0
+        else:
+            a_l_safe = a_l
+        kind_arr = np.fromiter(kind_l, dtype=np.int64, count=n)
+        a_all = np.fromiter(a_l_safe, dtype=np.int64, count=n)
+        b_all = np.fromiter(b_l, dtype=np.int64, count=n)
+
+        native = _native_schedule_alloc(
+            kind_arr, a_all, b_all, w_mul, w_lin, self.outputs)
+        if native is not None:
+            step_arr, last_use, reg_arr, n_steps, next_reg = native
+        else:
+            step_arr, last_use, reg_arr, n_steps, next_reg = (
+                self._schedule_alloc_py(
+                    kind_l, a_l, b_l, kind_arr, a_all, b_all, w_mul, w_lin))
+        alu_idx = np.flatnonzero(kind_arr >= 0)
+        n_alu = int(alu_idx.size)
+        a_arr = a_all[alu_idx]
+        b_arr = b_all[alu_idx]
+        alu_steps = step_arr[alu_idx]
+        kind_alu = kind_arr[alu_idx]
+
+        sched_steps = n_steps  # pre-padding schedule length
+        n_steps = -(-n_steps // pad_steps_to) * pad_steps_to
+        n_regs = next_reg
+        # trash registers for idle lanes
+        trash_mul = n_regs
+        trash_lin = n_regs + w_mul
+        n_regs += w_mul + w_lin
+        if n_regs < pad_regs_to:
+            n_regs = pad_regs_to
+
+        # 4) instruction arrays (vectorized): lanes are the within-step
+        #    rank in creation order; idle lanes pre-filled with their trash
+        #    destination registers (zero sources)
+        reg_a = reg_arr.astype(np.int32)
+        msa = np.zeros((n_steps, w_mul), dtype=np.int32)
+        msb = np.zeros((n_steps, w_mul), dtype=np.int32)
+        msd = np.empty((n_steps, w_mul), dtype=np.int32)
+        msd[:] = trash_mul + np.arange(w_mul, dtype=np.int32)
+        lsa = np.zeros((n_steps, w_lin), dtype=np.int32)
+        lsb = np.zeros((n_steps, w_lin), dtype=np.int32)
+        lsub = np.zeros((n_steps, w_lin), dtype=bool)
+        lsd = np.empty((n_steps, w_lin), dtype=np.int32)
+        lsd[:] = trash_lin + np.arange(w_lin, dtype=np.int32)
+
+        is_mul = kind_alu == _MUL
+        for unit_sel, (ma, mb, md) in ((is_mul, (msa, msb, msd)),
+                                       (~is_mul, (lsa, lsb, lsd))):
+            sel = np.flatnonzero(unit_sel)
+            if not sel.size:
+                continue
+            steps_u = alu_steps[sel]
+            o = np.argsort(steps_u, kind="stable")
+            ss = steps_u[o]
+            so = sel[o]
+            # lane = rank within the step group (creation order preserved)
+            group_start = np.r_[0, np.flatnonzero(np.diff(ss)) + 1]
+            lanes = np.arange(ss.size, dtype=np.int64)
+            lanes -= np.repeat(group_start,
+                               np.diff(np.r_[group_start, ss.size]))
+            ma[ss, lanes] = reg_a[a_arr[so]]
+            mb[ss, lanes] = reg_a[b_arr[so]]
+            md[ss, lanes] = reg_a[alu_idx[so]]
+            if md is lsd:
+                lsub[ss, lanes] = kind_alu[so] == _SUB
+
+        const_payload = {
+            int(reg_arr[idx]): ops[idx].a for idx in self.consts.values()
+        }
+        input_regs = [int(reg_arr[i]) for i in self.inputs]
+        output_regs = [int(reg_arr[i]) for i in self.outputs]
+
+        n_mul = int(is_mul.sum())
+        n_lin = n_alu - n_mul
+
+        if annotate:
+            # write the schedule back onto the IR (vm_analysis reads
+            # step/last_use_step/reg off the ops); a fresh assemble always
+            # rewrites all three, so stale shapes cannot bleed through
+            step_l = step_arr.tolist()
+            last_l = last_use.tolist()
+            reg_l = reg_arr.tolist()
+            for i, op in enumerate(ops):
+                op.step = step_l[i]
+                op.last_use_step = last_l[i]
+                op.reg = reg_l[i]
+        return Program(
+            n_regs=n_regs,
+            instr=(msa, msb, msd, lsa, lsb, lsub, lsd),
+            input_regs=np.asarray(input_regs, dtype=np.int32),
+            input_names=list(self.input_names),
+            output_regs=np.asarray(output_regs, dtype=np.int32),
+            output_names=list(self.output_names),
+            const_regs=const_payload,
+            n_steps=n_steps,
+            # schedule metadata for vm_analysis.program_stats — lets the
+            # analyzer report on cache-loaded assembled programs whose IR
+            # is not in memory (old .vm_cache pickles lack it: meta=None)
+            meta={
+                "sched_steps": sched_steps,
+                "n_mul": n_mul,
+                "n_lin": n_lin,
+                "alloc_regs": next_reg,
+                "trash_mul": trash_mul,
+                "trash_lin": trash_lin,
+                "w_mul": w_mul,
+                "w_lin": w_lin,
+            },
+        )
+
+
+    def _schedule_alloc_py(self, kind_l, a_l, b_l, kind_arr, a_all, b_all,
+                           w_mul, w_lin):
+        """Pure-Python twin of the native scheduling+allocation kernel
+        (csrc/vm_sched.c): the always-available fallback, ~1M ops/sec.
+        Returns (step, last_use, reg, n_steps, alloc_regs) as int64 arrays
+        + ints, bit-identical to the native kernel and to the legacy
+        scheduler."""
+        n = len(kind_l)
+
+        # 1) bucketed list scheduling: per-unit lane-fill counters plus a
+        #    union-find over steps ("first step >= t with a free lane").
+        #    A full step's root points one past itself, so probing a long
+        #    saturated prefix costs one path-compressed find instead of a
+        #    linear rescan.
+        step: List[int] = [-1] * n
+        fill0: List[int] = []
+        fill1: List[int] = []
+        nxt0: List[int] = []
+        nxt1: List[int] = []
+        ln0 = ln1 = 0
+        for i, (k, ai, bi) in enumerate(zip(kind_l, a_l, b_l)):
+            if k < 0:
+                continue  # input/const: defined before step 0
+            sa = step[ai]
+            sb = step[bi]
+            t = (sa if sa >= sb else sb) + 1
+            if k == 0:  # _MUL
+                f, nx, ln, width = fill0, nxt0, ln0, w_mul
+            else:
+                f, nx, ln, width = fill1, nxt1, ln1, w_lin
+            if t >= ln:
+                while ln <= t:
+                    nx.append(ln)
+                    f.append(0)
+                    ln += 1
+                r = t
+            else:
+                # find the root (first candidate free step >= t),
+                # path-compressing the chain walked
+                r = t
+                x = nx[r]
+                if x != r:
+                    chain = []
+                    ap_c = chain.append
+                    while True:
+                        ap_c(r)
+                        r = x
+                        if r == ln:
+                            nx.append(ln)
+                            f.append(0)
+                            ln += 1
+                            break
+                        x = nx[r]
+                        if x == r:
+                            break
+                    for c in chain:
+                        nx[c] = r
+            if k == 0:
+                ln0 = ln
+            else:
+                ln1 = ln
+            cnt = f[r] + 1
+            f[r] = cnt
+            if cnt == width:
+                nx[r] = r + 1
+            step[i] = r
+
+        n_steps = ln0 if ln0 >= ln1 else ln1
+
+        # 2) liveness (vectorized): last step at which each value is read
+        step_arr = np.fromiter(step, dtype=np.int64, count=n)
+        alu_idx = np.flatnonzero(kind_arr >= 0)
+        alu_steps = step_arr[alu_idx]
+        last_use = np.full(n, -1, dtype=np.int64)
+        np.maximum.at(last_use, a_all[alu_idx], alu_steps)
+        np.maximum.at(last_use, b_all[alu_idx], alu_steps)
+        if self.outputs:
+            last_use[np.asarray(self.outputs)] = n_steps + 1  # live to end
+
+        # 3) linear-scan register allocation (reg 0 = always-zero scratch
+        #    source for idle lanes). Same policy as ever: defs claim the
+        #    most recently freed register (LIFO), frees happen after each
+        #    step's last use — kept as a tight index loop over the
+        #    step-sorted ALU ops with per-step expiry lists.
+        reg_l = [-1] * n
+        next_reg = 1
+        free: List[int] = []
+        # regs to free after step t; entries past the walked range (outputs
+        # at n_steps + 1) are simply never freed, as before
+        expiry: List[List[int]] = [[] for _ in range(n_steps + 2)]
+
+        last_l = last_use.tolist()
+        # inputs and constants in creation order, defined "before step 0"
+        for i in sorted(self.inputs + list(self.consts.values())):
+            if free:
+                r = free.pop()
+            else:
+                r = next_reg
+                next_reg += 1
+            reg_l[i] = r
+            lu = last_l[i]
+            if lu >= 0:
+                expiry[lu].append(r)
+            # dead input/const: legacy pended the free on step -1, which
+            # the step walk never reaches — so: never freed
+        # ALU defs in (step, creation) order; stable sort keeps creation
+        # order within a step, matching the legacy by_step walk
+        alloc_order = np.argsort(alu_steps, kind="stable")
+        order = alu_idx[alloc_order].tolist()
+        order_steps = alu_steps[alloc_order].tolist()
+        order_last = last_use[alu_idx][alloc_order].tolist()
+        cur = 0
+        free_pop = free.pop
+        free_ext = free.extend
+        for i, t, lu in zip(order, order_steps, order_last):
+            while cur < t:  # free everything expiring strictly before t
+                e = expiry[cur]
+                if e:
+                    free_ext(e)
+                cur += 1
+            if free:
+                r = free_pop()
+            else:
+                r = next_reg
+                next_reg += 1
+            reg_l[i] = r
+            expiry[lu if lu >= 0 else t].append(r)
+
+        reg_arr = np.fromiter(reg_l, dtype=np.int64, count=n)
+        return step_arr, last_use, reg_arr, n_steps, next_reg
+
+    def assemble_legacy(
+        self,
+        w_mul: int = 128,
+        w_lin: int = 128,
+        pad_steps_to: int = 1,
+        pad_regs_to: int = 1,
+    ) -> "Program":
+        """The pre-bucketing reference scheduler, kept VERBATIM as the
+        equivalence oracle: tests/test_vm_scheduler.py gates that
+        ``assemble`` produces bit-identical instruction tensors (and
+        therefore bit-identical outputs) for every registry program, and
+        the assembly-throughput smoke races the two on the chunk-16
+        rlc_combine. Not used by any production path."""
         ops = self.ops
         n = len(ops)
         is_alu = [op.kind in (_MUL, _ADD, _SUB) for op in ops]
@@ -353,9 +705,6 @@ class Prog:
             output_names=list(self.output_names),
             const_regs=const_payload,
             n_steps=n_steps,
-            # schedule metadata for vm_analysis.program_stats — lets the
-            # analyzer report on cache-loaded assembled programs whose IR
-            # is not in memory (old .vm_cache pickles lack it: meta=None)
             meta={
                 "sched_steps": sched_steps,
                 "n_mul": n_mul,

@@ -499,6 +499,11 @@ def registry_programs(tier1_only: bool = False) -> List[Tuple[str, str, int, int
         ("aggregate_verify", 2, 1),
         ("rlc_combine", 2, 1),
         ("hard_part", 0, 1),
+        # the ISSUE 10 width-for-depth hard-part variants: the tier-1 gate
+        # pins their recovered critical path (frobenius 1840 vs the legacy
+        # 4740) so a formula edit cannot silently grow the depth back
+        ("hard_part_windowed", 0, 1),
+        ("hard_part_frobenius", 0, 1),
         ("g1_subgroup", 0, 1),
         ("g2_subgroup", 0, 1),
         ("h2g_finish", 0, 1),
@@ -513,6 +518,11 @@ def registry_programs(tier1_only: bool = False) -> List[Tuple[str, str, int, int
         # width report must cover the narrow-chunk shape too
         ("rlc_combine", 4, 1),
         ("hard_part", 0, 8),
+        # the pipelined multi-row shape (_fold_for caps the new variants
+        # at 8): by fold 8 the frobenius schedule is work-bound enough to
+        # classify balanced — width now hides the residual depth
+        ("hard_part_windowed", 0, 8),
+        ("hard_part_frobenius", 0, 8),
         ("g1_subgroup", 0, 4),
         ("g2_subgroup", 0, 8),
         ("h2g_finish", 0, 4),

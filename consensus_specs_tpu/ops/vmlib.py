@@ -907,6 +907,258 @@ def build_rlc_combine(n: int, fold: int = 1) -> Prog:
     return prog
 
 
+# ---------------------------------------------------------------------------
+# width-for-depth hard-part variants (ISSUE 10): depth-lean cyclotomic
+# squarings + windowed / Frobenius-decomposed exponentiation chains
+# ---------------------------------------------------------------------------
+
+
+def f12_cyclotomic_square_comps(prog: Prog, c: List[F2]) -> List[F2]:
+    """Granger-Scott cyclotomic squaring, COMPONENT form in and out, with
+    the critical path flattened to ~5 ALU levels (the flat-basis
+    `f12_cyclotomic_square` costs ~11: comps round-trips, chained
+    double/add tails, Karatsuba pre-adds).
+
+    The trade is width for depth: every output coefficient is a balanced
+    signed tree over schoolbook products whose constant factors (3x, 6x
+    from the `3t +- 2c` recombination and the xi fold) are PREMULTIPLIED
+    into one operand as const muls — one extra mul level replaces the
+    two-level `(t - c).double() + t` tail and every Karatsuba pre-add.
+    ~54 Fq muls per squaring instead of 27, which is free on a depth-bound
+    schedule (the mul lanes idle ~95% of the time at fold 1) and exactly
+    what the hard part's serial squaring spine needs.
+
+    Bounds stay compress-free: products of <=2^385 operands land at
+    ~p + 2^350, and every output is a <=6-term signed sum of those, so the
+    fixed point is ~2^384 — well inside both sub preconditions and the
+    15-limb capacity."""
+    three = prog.const(3)
+    six = prog.const(6)
+    c0b0, c1b0, c0b1, c1b1, c0b2, c1b2 = c
+
+    def dbl(v: Val) -> Val:
+        return v + v
+
+    def type_a(u: F2, v: F2, s: F2) -> F2:
+        """3*(u^2 + xi*v^2) - 2s, depth 5."""
+        a0 = (u.c0 * three) * u.c0
+        a1 = (u.c1 * three) * u.c1
+        b0 = (v.c0 * three) * v.c0
+        b1 = (v.c1 * three) * v.c1
+        cv = (v.c0 * six) * v.c1
+        cu = (u.c0 * six) * u.c1
+        d_u = a0 - a1
+        d_v = b0 - b1
+        w0 = (d_u + d_v) - (cv + dbl(s.c0))
+        w1 = ((cu - dbl(s.c1)) + d_v) + cv
+        return F2(w0, w1)
+
+    def type_b(u: F2, v: F2, s: F2) -> F2:
+        """6*(u*v) + 2s, depth 4."""
+        p = (u.c0 * six) * v.c0
+        q = (u.c1 * six) * v.c1
+        r = (u.c0 * six) * v.c1
+        t = (u.c1 * six) * v.c0
+        return F2((p - q) + dbl(s.c0), (r + t) + dbl(s.c1))
+
+    def type_c(u: F2, v: F2, s: F2) -> F2:
+        """6*xi*(u*v) + 2s, depth 5."""
+        p = (u.c0 * six) * v.c0
+        q = (u.c1 * six) * v.c1
+        r = (u.c0 * six) * v.c1
+        t = (u.c1 * six) * v.c0
+        d1 = p - q
+        d2 = r + t
+        return F2((d1 - d2) + dbl(s.c0), (d1 + d2) + dbl(s.c1))
+
+    z0 = type_a(c0b0, c1b1, c0b0)
+    z1 = type_a(c1b0, c0b2, c0b1)
+    z2 = type_a(c0b1, c1b2, c0b2)
+    z3 = type_c(c0b1, c1b2, c1b0)
+    z4 = type_b(c0b0, c1b1, c1b1)
+    z5 = type_b(c0b2, c1b0, c1b2)
+    return [z0, z3, z1, z4, z2, z5]
+
+
+def _cyc_pow_spine(prog: Prog, base: List[F2], e: int) -> List[Val]:
+    """base^e (static positive exponent, unitary base) with the squaring
+    SPINE kept off the multiply path: s_j = base^(2^j) is a pure chain of
+    depth-5 cyclotomic squarings, and the set bits' terms fold into a flat
+    running product as they appear. Gaps between set bits are >= 1
+    squaring, so most product multiplies are absorbed into the spine's
+    timeline instead of extending it — the critical path is ~5 levels per
+    exponent bit plus ONE dense multiply tail, not a multiply per set bit.
+    Returns the flat Fq12 product."""
+    assert e > 0
+    s = base
+    acc: List[Val] = None
+    nbits = e.bit_length()
+    for j in range(nbits):
+        if (e >> j) & 1:
+            term = f12_from_comps(s)
+            acc = term if acc is None else f12_mul(prog, acc, term)
+        if j != nbits - 1:
+            s = f12_cyclotomic_square_comps(prog, s)
+    return acc
+
+
+_ABS_X = -X_PARAM  # |x|, the positive BLS parameter magnitude
+
+
+def _window_digits(e: int, w: int) -> List[int]:
+    """MSB-first sliding-window recoding of a positive exponent: returns a
+    list where 0 means "square" and an odd digit d means "square then
+    multiply by base^d". The first entry is the leading digit (no squaring
+    before it)."""
+    bits = [int(b) for b in bin(e)[2:]]
+    out: List[int] = []
+    i = 0
+    first = True
+    while i < len(bits):
+        if bits[i] == 0:
+            out.append(0)
+            i += 1
+            continue
+        # window of up to w bits ending in a 1
+        j = min(i + w, len(bits))
+        while bits[j - 1] == 0:
+            j -= 1
+        d = int("".join(map(str, bits[i:j])), 2)
+        if first:
+            out.append(-d)  # leading digit: load, no squarings yet
+            first = False
+        else:
+            out.extend([0] * (j - i - 1))
+            out.append(d)
+        i = j
+    return out
+
+
+def _cyc_pow_window(prog: Prog, h: List[Val], e: int, w: int = 3) -> List[Val]:
+    """h^e (static positive exponent, unitary h, flat in/out) via sliding-
+    window exponentiation: the small odd-power table {h, h^3, ..} is
+    precomputed in parallel WIDTH (its muls all hang off h and h^2, away
+    from the ladder's critical path), the ladder itself runs depth-lean
+    cyclotomic squarings in component form, and set bits collapse into
+    one table multiply per window instead of one per bit."""
+    digits = _window_digits(e, w)
+    needed = sorted({abs(d) for d in digits if d} - {1})
+    table = {1: h}
+    if needed:
+        h2 = f12_from_comps(f12_cyclotomic_square_comps(prog, f12_to_comps(h)))
+        prev = h
+        for d in range(3, needed[-1] + 1, 2):
+            prev = f12_mul(prog, prev, h2)
+            if d in needed:
+                table[d] = prev
+    acc: List[F2] = None
+    for d in digits:
+        if d < 0:  # leading digit
+            acc = f12_to_comps(table[-d])
+            continue
+        acc = f12_cyclotomic_square_comps(prog, acc)
+        if d:
+            m = f12_mul(prog, f12_from_comps(acc), table[d])
+            acc = f12_to_comps(m)
+    return f12_from_comps(acc)
+
+
+def _emit_hard_part_windowed(prog: Prog, ns: str) -> None:
+    """The legacy HHT chain with windowed, depth-lean exponentiations:
+    same `(x-1)^2 * (x+p) * (x^2+p^2-1) + 3` structure as
+    `_emit_hard_part`, but every `g^|x|` ladder runs component-form
+    cyclotomic squarings (5 levels vs ~11) with sliding-window table
+    multiplies."""
+    g = [prog.inp(f"{ns}g.{i}") for i in range(12)]
+
+    def px(h):  # h^x = conj(h^|x|)
+        return f12_conj(prog, _cyc_pow_window(prog, h, _ABS_X))
+
+    def px1(h):  # h^(x-1) = conj(h^(|x|+1))
+        return f12_conj(prog, _cyc_pow_window(prog, h, _ABS_X + 1))
+
+    t0 = px1(px1(g))  # g^((x-1)^2)
+    t1 = f12_mul(prog, px(t0), f12_frobenius(prog, t0, 1))
+    t2 = px(px(t1))
+    t2 = f12_mul(prog, t2, f12_frobenius(prog, t1, 2))
+    t2 = f12_mul(prog, t2, f12_conj(prog, t1))
+    res = f12_mul(prog, t2, f12_mul(prog, f12_square(prog, g), g))
+    for i in range(12):
+        prog.out(res[i], f"{ns}res.{i}")
+
+
+def build_hard_part_windowed(fold: int = 1) -> Prog:
+    """PROG B variant 'windowed': HHT with sliding-window ladders over
+    depth-lean component-form cyclotomic squarings. Same I/O contract as
+    build_hard_part (g.0..11 -> res.0..11). Critical path ~2.1x shorter
+    than the bit-serial legacy chain; the Frobenius variant below goes
+    further."""
+    prog = Prog()
+    if fold == 1:
+        _emit_hard_part_windowed(prog, "")
+    else:
+        for t in range(fold):
+            _emit_hard_part_windowed(prog, f"i{t}.")
+    return prog
+
+
+def _emit_hard_part_frobenius(prog: Prog, ns: str) -> None:
+    """Frobenius-heavy decomposition of the hard part: write
+    3*(p^4-p^2+1)/r = l0 + l1*p + l2*p^2 + l3*p^3 with
+        l3 = (x-1)^2,  l2 = l3*x,  l1 = l3*(x^2-1),  l0 = l1*x + 3,
+    so with A = g^((|x|+1)^2) (note (x-1)^2 = (|x|+1)^2 for the negative
+    BLS x) and B = A^|x|, C = B^|x|, D = C^|x|:
+
+        res = conj(D)*B*g^3 * frob(C*conj(A)) * frob^2(conj(B)) * frob^3(A)
+
+    (conj == inverse on the cyclotomic subgroup, and the q-power Frobenius
+    maps are coefficient conjugations/constant multiplies — depth ~2).
+    The four chains are SEQUENTIAL squaring spines (127 + 3*63 squarings,
+    the log2(l0) floor no addition chain can beat), but each spine is pure
+    depth-5 cyclotomic squarings with the set-bit products deferred off
+    the critical path (_cyc_pow_spine), so the whole program's critical
+    path lands at ~1.8k levels — ~2.7x below the 4864-step legacy chain —
+    while the extra width (schoolbook const-folded squarings, spine
+    product terms) rides the idle mul lanes."""
+    g = [prog.inp(f"{ns}g.{i}") for i in range(12)]
+    gc = f12_to_comps(g)
+
+    A = _cyc_pow_spine(prog, gc, (_ABS_X + 1) ** 2)
+    B = _cyc_pow_spine(prog, f12_to_comps(A), _ABS_X)
+    C = _cyc_pow_spine(prog, f12_to_comps(B), _ABS_X)
+    D = _cyc_pow_spine(prog, f12_to_comps(C), _ABS_X)
+
+    # g^3 = g^2 * g: the g^2 squaring CSEs against chain A's spine head,
+    # so this costs one dense mul, parallel to the spines
+    g2 = f12_from_comps(f12_cyclotomic_square_comps(prog, gc))
+    g3 = f12_mul(prog, g2, g)
+
+    e0 = f12_mul(prog, f12_mul(prog, f12_conj(prog, D), B), g3)
+    e1 = f12_frobenius(prog, f12_mul(prog, C, f12_conj(prog, A)), 1)
+    e2 = f12_frobenius(prog, f12_conj(prog, B), 2)
+    e3 = f12_frobenius(prog, A, 3)
+    res = f12_mul(prog, f12_mul(prog, e0, e1), f12_mul(prog, e2, e3))
+    for i in range(12):
+        prog.out(res[i], f"{ns}res.{i}")
+
+
+def build_hard_part_frobenius(fold: int = 1) -> Prog:
+    """PROG B variant 'frobenius': the lambda-decomposed hard part (see
+    _emit_hard_part_frobenius). Same I/O contract as build_hard_part.
+    This is the width-for-depth flagship: critical path ~2.7x below the
+    legacy chain at ANY fold, and by fold 8 the schedule is work-bound
+    ('balanced'), so pipelined rows convert the recovered depth into
+    per-row throughput (ops/bls_backend._run_hard_part routes here by
+    default via CONSENSUS_SPECS_TPU_HARD_PART)."""
+    prog = Prog()
+    if fold == 1:
+        _emit_hard_part_frobenius(prog, "")
+    else:
+        for t in range(fold):
+            _emit_hard_part_frobenius(prog, f"i{t}.")
+    return prog
+
+
 def _emit_hard_part(prog: Prog, ns: str) -> None:
     g = [prog.inp(f"{ns}g.{i}") for i in range(12)]
 
@@ -951,8 +1203,74 @@ BUILDERS = {
     "miller_product": lambda k, fold=1: build_miller_product(k, fold),
     "aggregate_verify": lambda k, fold=1: build_aggregate_verify_miller(k, fold),
     "hard_part": lambda k, fold=1: build_hard_part(fold),
+    "hard_part_windowed": lambda k, fold=1: build_hard_part_windowed(fold),
+    "hard_part_frobenius": lambda k, fold=1: build_hard_part_frobenius(fold),
     "rlc_combine": lambda k, fold=1: build_rlc_combine(k, fold),
     "g1_subgroup": lambda k, fold=1: build_g1_subgroup_check(fold),
     "g2_subgroup": lambda k, fold=1: build_g2_subgroup_check(fold),
     "h2g_finish": lambda k, fold=1: build_h2g_finish(fold),
 }
+
+# Per-kind source ownership for the .vm_cache fingerprint split
+# (ops/bls_backend._program_fingerprint): each kind CLAIMS the functions
+# only it uses — its builder + emit body (+ kind-private helpers). Claimed
+# sources are cut OUT of the shared-module hash and hashed into their own
+# kind's key only, so editing one builder re-keys just that kind's cached
+# programs instead of the whole cache. Anything NOT claimed (the F2/Fq12
+# algebra, the Miller steps, the cyclotomic helpers) stays in the shared
+# hash — conservative by construction: an unclaimed edit re-keys
+# everything, a claimed edit can never leak into another kind's programs.
+BUILDER_LOCAL_FNS = {
+    "miller_product": (build_miller_product, _emit_miller_product),
+    "aggregate_verify": (build_aggregate_verify_miller,
+                         _emit_aggregate_verify_miller),
+    "hard_part": (build_hard_part, _emit_hard_part),
+    "hard_part_windowed": (build_hard_part_windowed,
+                           _emit_hard_part_windowed),
+    "hard_part_frobenius": (build_hard_part_frobenius,
+                            _emit_hard_part_frobenius),
+    "rlc_combine": (build_rlc_combine, _emit_rlc_combine),
+    "g1_subgroup": (build_g1_subgroup_check, _emit_g1_subgroup_check),
+    "g2_subgroup": (build_g2_subgroup_check, _emit_g2_subgroup_check),
+    "h2g_finish": (build_h2g_finish, _emit_h2g_finish, _emit_iso_map_g2,
+                   _f2_horner),
+}
+
+
+def builder_source_parts(kind: str):
+    """(shared_src, local_src) for ``kind``: the vmlib module source with
+    every claimed function body cut out, plus this kind's own claimed
+    sources. A claimed body that cannot be located in the module source
+    (decorator drift, exec'd code) falls back into shared — coarser keys,
+    never a stale hit."""
+    import inspect
+
+    global _SHARED_SRC_CACHE
+    if _SHARED_SRC_CACHE is None:
+        try:
+            with open(__file__, "r") as fh:
+                shared = fh.read()
+        except OSError:
+            # source-less deployment (pyc-only/frozen): degrade to one
+            # coarse shared key — same posture as the old whole-module
+            # fingerprint's repr fallback, never a crash on _program()
+            _SHARED_SRC_CACHE = (f"<no-source:{__name__}>", {})
+            return _SHARED_SRC_CACHE[0], ""
+        locals_src = {}
+        for k, fns in BUILDER_LOCAL_FNS.items():
+            parts = []
+            for fn in fns:
+                try:
+                    src = inspect.getsource(fn)
+                except (OSError, TypeError):
+                    continue  # not found: its text stays in shared
+                if src in shared:
+                    shared = shared.replace(src, f"<claimed:{k}:{fn.__name__}>")
+                    parts.append(src)
+            locals_src[k] = "".join(parts)
+        _SHARED_SRC_CACHE = (shared, locals_src)
+    shared, locals_src = _SHARED_SRC_CACHE
+    return shared, locals_src.get(kind, "")
+
+
+_SHARED_SRC_CACHE = None

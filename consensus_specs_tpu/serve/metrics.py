@@ -257,5 +257,14 @@ class ServeMetrics:
                 "prep": prep_stats,
                 "rlc": rlc_stats,
                 "final_exps_per_item": round(final_exps_per_item, 4),
+                # rows the last device finalization window coalesced
+                # (ISSUE 10 pipelined multi-row route; 0 = host route or
+                # no device finalization yet this process) — gauge read
+                # via stats_and_gauges: one lock-protected dict copy, no
+                # latency-histogram merge under this snapshot's lock
+                "final_exp_rows_inflight": int(
+                    profiling.stats_and_gauges()[1]
+                    .get("bls.final_exp_rows_inflight", 0)
+                ),
                 "latency": lat,
             }

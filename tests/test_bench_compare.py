@@ -431,3 +431,74 @@ def test_real_repo_rounds_pass(bc, monkeypatch):
     runs; the ambient env knob must not change the test's meaning)."""
     monkeypatch.delenv("BENCH_COMPARE_MAX_REGRESSION", raising=False)
     assert bc.main([]) == 0
+
+
+# -- the finalexp hard-part race gate (ISSUE 10) ----------------------------
+
+
+def _fx_parsed(value, cells, **extra):
+    """A --mode finalexp round: cells maps "variant,rows" ->
+    (ok, ms_per_row)."""
+    section = {
+        name: {"ok": ok, "ms_per_row": ms}
+        for name, (ok, ms) in cells.items()
+    }
+    return _parsed(value, mode="finalexp", n=None, k=None,
+                   finalexp=section, **extra)
+
+
+def test_finalexp_newly_erroring_variant_fails(tmp_path, bc, capsys):
+    """A hard-part variant cell that verified last round and errors in the
+    newest fails outright — losing a finalization variant is a
+    correctness/availability regression (mirror of MESH ERRORED)."""
+    _write_round(tmp_path, 1, _fx_parsed(
+        8.0, {"host,1": (True, 16.5), "frobenius,2": (True, 269.0)}))
+    _write_round(tmp_path, 2, _fx_parsed(
+        8.0, {"host,1": (True, 16.5), "frobenius,2": (False, 0.0)}))
+    assert bc.main(["--dir", str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "cpu:finalexp:frobenius,2" in out and "FINALEXP ERRORED" in out
+
+
+def test_finalexp_ms_per_row_is_report_only(tmp_path, bc, capsys):
+    """ms/row movement — including a device route going slower than host —
+    never fails on its own (the route decision is auto-made per platform;
+    CPU numbers carry no accelerator signal)."""
+    _write_round(tmp_path, 1, _fx_parsed(
+        8.0, {"host,2": (True, 16.5), "frobenius,2": (True, 12.0)}))
+    _write_round(tmp_path, 2, _fx_parsed(
+        8.0, {"host,2": (True, 16.5), "frobenius,2": (True, 300.0)}))
+    assert bc.main(["--dir", str(tmp_path)]) == 0
+    assert "cpu:finalexp:frobenius,2" in capsys.readouterr().out
+
+
+def test_finalexp_still_erroring_is_not_a_new_failure(tmp_path, bc):
+    _write_round(tmp_path, 1, _fx_parsed(
+        8.0, {"host,1": (True, 16.5), "windowed,4": (False, 0.0)}))
+    _write_round(tmp_path, 2, _fx_parsed(
+        8.0, {"host,1": (True, 16.5), "windowed,4": (False, 0.0)}))
+    assert bc.main(["--dir", str(tmp_path)]) == 0
+
+
+def test_finalexp_keys_join_without_common_throughput_keys(tmp_path, bc,
+                                                           capsys):
+    """Shared finalexp cells are comparables in their own right (the
+    SLO/sim/mesh rule): disjoint throughput shapes must still gate an
+    ok -> error transition instead of skipping."""
+    _write_round(tmp_path, 1, _parsed(
+        1000.0, mode="head", n=None, k=None, blocks=1024,
+        finalexp={"bit_serial,1": {"ok": True, "ms_per_row": 1223.0}}))
+    _write_round(tmp_path, 2, _parsed(
+        900.0, mode="head", n=None, k=None, blocks=128,
+        finalexp={"bit_serial,1": {"ok": False, "ms_per_row": 0.0}}))
+    assert bc.main(["--dir", str(tmp_path)]) == 1
+    assert "FINALEXP ERRORED" in capsys.readouterr().out
+
+
+def test_finalexp_new_variant_cells_are_not_gated_until_seen(tmp_path, bc):
+    """A variant appearing for the first time (no previous-round cell) is
+    report-only — new variants join the gate once they have a baseline."""
+    _write_round(tmp_path, 1, _fx_parsed(8.0, {"host,1": (True, 16.5)}))
+    _write_round(tmp_path, 2, _fx_parsed(
+        8.0, {"host,1": (True, 16.5), "frobenius,8": (False, 0.0)}))
+    assert bc.main(["--dir", str(tmp_path)]) == 0

@@ -168,3 +168,254 @@ def test_prune_vm_cache_evicts_by_idle_age_and_size(tmp_path):
     # disabled rules (<= 0) evict nothing
     out = prune_vm_cache(max_age_days=0, max_bytes=0, cache_dir=d)
     assert out["evicted"] == 0 and out["kept"] == 1
+
+
+# -- final-exp row batching (ISSUE 10 tentpole layer 2) ----------------------
+
+
+def test_final_exp_batcher_coalesces_concurrent_rows(monkeypatch):
+    """Concurrent device-routed hard-part rows (one per flush) coalesce
+    into ONE multi-row VM execution, and the window's row count lands on
+    the bls.final_exp_rows_inflight gauge."""
+    import threading
+    import time as _time
+
+    import numpy as np
+
+    from consensus_specs_tpu.ops import bls_backend, fq, profiling
+
+    calls = []
+
+    def fake_run(rows, mesh=None, kind=None):
+        calls.append((rows.shape[0], kind))
+        _time.sleep(0.01)
+        return np.ones(rows.shape[0], dtype=bool)
+
+    monkeypatch.setattr(bls_backend, "_run_hard_part", fake_run)
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_FINAL_EXP_WINDOW_MS", "80")
+    batcher = bls_backend._FinalExpBatcher()
+    results = []
+    barrier = threading.Barrier(4)
+
+    def worker():
+        barrier.wait()
+        g = np.zeros((12, fq.NUM_LIMBS), dtype=np.uint64)
+        results.append(batcher.run(g))
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert results == [True] * 4
+    assert sum(c for c, _ in calls) == 4
+    assert len(calls) == 1, calls  # one coalesced window
+    # auto-routing at 4 rows picks the frobenius width-for-depth variant
+    assert calls[0][1] == "hard_part_frobenius"
+    gauge = profiling.summary()["bls.final_exp_rows_inflight"]["gauge"]
+    assert gauge == 4.0
+
+
+def test_final_exp_batcher_never_mixes_meshes(monkeypatch):
+    """Windows are keyed by mesh: a sharded caller's row must never be
+    diverted onto an unsharded leader's placement (or vice versa)."""
+    import threading
+
+    import numpy as np
+
+    from consensus_specs_tpu.ops import bls_backend, fq
+
+    calls = []
+
+    def fake_run(rows, mesh=None, kind=None):
+        calls.append((rows.shape[0], mesh))
+        return np.ones(rows.shape[0], dtype=bool)
+
+    monkeypatch.setattr(bls_backend, "_run_hard_part", fake_run)
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_FINAL_EXP_WINDOW_MS", "80")
+    batcher = bls_backend._FinalExpBatcher()
+    barrier = threading.Barrier(4)
+    results = []
+
+    def worker(mesh):
+        barrier.wait()
+        g = np.zeros((12, fq.NUM_LIMBS), dtype=np.uint64)
+        results.append(batcher.run(g, mesh=mesh))
+
+    # two callers per "mesh" (a hashable stand-in suffices for keying)
+    threads = [threading.Thread(target=worker, args=(m,))
+               for m in (None, "mesh-a", None, "mesh-a")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert results == [True] * 4
+    assert sorted(c for c, _ in calls) == [2, 2]  # one window per mesh key
+    assert sorted(str(m) for _, m in calls) == ["None", "mesh-a"]
+
+
+def test_final_exp_batcher_propagates_failures(monkeypatch):
+    """A failed window must fail EVERY joined caller (never hang a
+    follower), and later windows recover independently."""
+    import threading
+
+    import numpy as np
+
+    from consensus_specs_tpu.ops import bls_backend, fq
+
+    def boom(rows, mesh=None, kind=None):
+        raise RuntimeError("device fell over")
+
+    monkeypatch.setattr(bls_backend, "_run_hard_part", boom)
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_FINAL_EXP_WINDOW_MS", "50")
+    batcher = bls_backend._FinalExpBatcher()
+    errs = []
+    barrier = threading.Barrier(2)
+
+    def worker():
+        barrier.wait()
+        g = np.zeros((12, fq.NUM_LIMBS), dtype=np.uint64)
+        try:
+            batcher.run(g)
+            errs.append(None)
+        except RuntimeError as e:
+            errs.append(str(e))
+
+    threads = [threading.Thread(target=worker) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errs == ["device fell over"] * 2
+    # recovery: a later lone row succeeds once the backend does
+    monkeypatch.setattr(
+        bls_backend, "_run_hard_part",
+        lambda rows, mesh=None, kind=None: np.ones(rows.shape[0], dtype=bool))
+    g = np.zeros((12, fq.NUM_LIMBS), dtype=np.uint64)
+    assert batcher.run(g) is True
+
+
+def test_hard_part_kind_routing(monkeypatch):
+    """auto routes small row counts to the frobenius variant and
+    lane-saturated batches to the legacy bit-serial chain; the env pin
+    always wins."""
+    from consensus_specs_tpu.ops import bls_backend
+
+    monkeypatch.delenv("CONSENSUS_SPECS_TPU_HARD_PART", raising=False)
+    assert bls_backend._hard_part_kind(1) == "hard_part_frobenius"
+    assert bls_backend._hard_part_kind(16) == "hard_part_frobenius"
+    assert bls_backend._hard_part_kind(17) == "hard_part"
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_HARD_PART", "windowed")
+    assert bls_backend._hard_part_kind(1) == "hard_part_windowed"
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_HARD_PART", "bit_serial")
+    assert bls_backend._hard_part_kind(1) == "hard_part"
+
+
+# -- per-program .vm_cache keys (ISSUE 10 satellite) -------------------------
+
+
+def test_program_fingerprints_are_per_kind():
+    """Every registry kind gets its own cache fingerprint, derived from
+    (vm+fq core, shared vmlib source, the kind's claimed builder source)
+    — so keys are distinct and deterministic."""
+    from consensus_specs_tpu.ops import bls_backend, vmlib
+
+    fps = {k: bls_backend._program_fingerprint(k) for k in vmlib.BUILDERS}
+    assert len(set(fps.values())) == len(fps)  # all distinct
+    # stable across calls (lru + deterministic hashing)
+    assert fps["hard_part"] == bls_backend._program_fingerprint("hard_part")
+
+
+def test_builder_source_split_claims_only_its_kind():
+    """The shared/local source split behind the per-program keys: each
+    kind's emit/builder bodies are cut out of the shared hash and claimed
+    by that kind alone, while shared algebra stays in the shared part."""
+    from consensus_specs_tpu.ops import vmlib
+
+    shared, local_hp = vmlib.builder_source_parts("hard_part")
+    _, local_frob = vmlib.builder_source_parts("hard_part_frobenius")
+    assert "def _emit_hard_part(" not in shared
+    assert "def _emit_hard_part_frobenius(" not in shared
+    assert "def _emit_hard_part(" in local_hp
+    assert "def _emit_hard_part_frobenius(" in local_frob
+    assert "def _emit_hard_part_frobenius(" not in local_hp
+    # shared helpers every builder leans on remain in the shared hash
+    assert "def f12_mul(" in shared
+    assert "def f12_cyclotomic_square_comps(" in shared
+
+
+def test_editing_one_builder_rekeys_only_that_kind(monkeypatch):
+    """The satellite's whole point: a one-builder edit must re-key only
+    that kind's cached programs (simulated by perturbing one kind's
+    claimed source through builder_source_parts)."""
+    from consensus_specs_tpu.ops import bls_backend, vmlib
+
+    before = {
+        k: bls_backend._program_fingerprint(k)
+        for k in ("hard_part", "hard_part_frobenius", "rlc_combine")
+    }
+    real = vmlib.builder_source_parts
+
+    def perturbed(kind):
+        shared, local = real(kind)
+        if kind == "hard_part_frobenius":
+            local = local + "# edited\n"
+        return shared, local
+
+    monkeypatch.setattr(vmlib, "builder_source_parts", perturbed)
+    bls_backend._program_fingerprint.cache_clear()
+    bls_backend._core_fingerprint_parts.cache_clear()
+    try:
+        after = {
+            k: bls_backend._program_fingerprint(k)
+            for k in ("hard_part", "hard_part_frobenius", "rlc_combine")
+        }
+    finally:
+        monkeypatch.undo()
+        bls_backend._program_fingerprint.cache_clear()
+        bls_backend._core_fingerprint_parts.cache_clear()
+    assert after["hard_part_frobenius"] != before["hard_part_frobenius"]
+    assert after["hard_part"] == before["hard_part"]
+    assert after["rlc_combine"] == before["rlc_combine"]
+
+
+def test_prune_evicts_stale_fingerprint_entries(tmp_path):
+    """Entries whose cache version or per-program fingerprint no longer
+    matches the current sources can never hit again — prune_vm_cache
+    evicts them regardless of age; unknown kinds and current-fingerprint
+    entries stay."""
+    import os
+
+    from consensus_specs_tpu.ops import bls_backend
+    from consensus_specs_tpu.ops.bls_backend import (
+        _VM_CACHE_VERSION,
+        prune_vm_cache,
+    )
+
+    d = str(tmp_path)
+    cur_fp = bls_backend._program_fingerprint("hard_part")
+    v = _VM_CACHE_VERSION
+    names = {
+        # current version + current fingerprint: kept
+        f"v{v}_{cur_fp}_hard_part_k0_f1_w96x192_p256.pkl": False,
+        # current version, stale fingerprint for a known kind: evicted
+        f"v{v}_{'0' * 10}_hard_part_k0_f32_w96x192_p256.pkl": True,
+        # old cache version: evicted
+        f"v{v - 1}_{'a' * 10}_hard_part_k0_f1_w96x192_p256.pkl": True,
+        # unknown kind (older/newer checkout): kept for age/size rules
+        f"v{v}_{'b' * 10}_future_kind_k0_f1_w96x192_p256.pkl": False,
+        # non-cache-shaped name: untouched
+        "v1_aaaa_old1.pkl": False,
+    }
+    for name in names:
+        with open(os.path.join(d, name), "wb") as fh:
+            fh.write(b"\x00" * 10)
+    out = prune_vm_cache(max_age_days=0, max_bytes=0, cache_dir=d)
+    assert out["evicted"] == 2
+    left = set(os.listdir(d))
+    for name, evicted in names.items():
+        assert (name not in left) == evicted, name
+    # evict_stale=False restores the pure age/size behavior
+    out = prune_vm_cache(max_age_days=0, max_bytes=0, cache_dir=d,
+                         evict_stale=False)
+    assert out["evicted"] == 0

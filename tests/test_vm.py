@@ -197,6 +197,79 @@ def test_f12_cyclotomic_square_vs_oracle():
     assert got == g * g
 
 
+def _rand_unitary():
+    f = _rand_fq12()
+    g = f.conjugate() * f.inverse()
+    return g.frobenius().frobenius() * g
+
+
+def test_f12_cyclotomic_square_comps_vs_oracle():
+    """The depth-lean component-form squaring (ISSUE 10): same map as the
+    flat Granger-Scott squaring, ~5 ALU levels instead of ~11."""
+    def fn(p, a):
+        return vmlib.f12_from_comps(
+            vmlib.f12_cyclotomic_square_comps(p, vmlib.f12_to_comps(a)))
+
+    g = _rand_unitary()
+    assert _f12_run(_f12_prog(fn), g) == g * g
+
+
+def test_cyc_pow_spine_and_window_vs_oracle():
+    """The two new static-exponent ladders on a unitary base: the
+    deferred-product spine (frobenius variant) and the sliding-window
+    ladder (windowed variant), each vs exact-int pow."""
+    e = 0xD3A1  # several set bits incl. adjacent ones
+    g = _rand_unitary()
+
+    def spine(p, a):
+        return vmlib._cyc_pow_spine(p, vmlib.f12_to_comps(a), e)
+
+    def window(p, a):
+        return vmlib._cyc_pow_window(p, a, e)
+
+    exp = g
+    for b in bin(e)[3:]:
+        exp = exp * exp
+        if b == "1":
+            exp = exp * g
+    assert _f12_run(_f12_prog(spine), g) == exp
+    assert _f12_run(_f12_prog(window), g) == exp
+
+
+def _oracle_hard_part(g):
+    # the one shared exact-int HHT chain (bls_backend owns the formula)
+    from consensus_specs_tpu.ops.bls_backend import hard_part_res_oracle
+
+    return hard_part_res_oracle(g)
+
+
+@pytest.mark.parametrize("builder", [
+    vmlib.build_hard_part_windowed,
+    vmlib.build_hard_part_frobenius,
+], ids=["windowed", "frobenius"])
+def test_hard_part_variants_vs_oracle(builder):
+    """The ISSUE 10 width-for-depth hard parts are BIT-identical to the
+    exact-int HHT on random unitary inputs (production assembly shape, so
+    the executable is the one bls_backend routes to)."""
+    from consensus_specs_tpu.ops import bls_backend as bb
+
+    prog = builder(1)
+    pr = prog.assemble(w_mul=bb.W_MUL, w_lin=bb.W_LIN,
+                       pad_steps_to=bb.PAD_STEPS, pad_regs_to=bb._pow2(64))
+    from consensus_specs_tpu.ops.bls_backend import (
+        _flat_ints_to_oracle,
+        _oracle_to_flat_ints,
+    )
+
+    g = _rand_unitary()
+    flat = _oracle_to_flat_ints(g)
+    out = vm.execute(pr, {f"g.{i}": fq.to_mont_int(flat[i]) for i in range(12)})
+    got = _flat_ints_to_oracle(
+        [fq.from_mont_limbs(out[f"res.{i}"]) for i in range(12)]
+    )
+    assert got == _oracle_hard_part(g)
+
+
 # ---------------------------------------------------------------------------
 # the assembler's own bound machinery
 # ---------------------------------------------------------------------------

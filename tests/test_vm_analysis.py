@@ -175,6 +175,38 @@ def test_cost_report_classifies_and_predicts():
     assert len(c["mul_width_profile"]) == 8
 
 
+# the legacy bit-serial hard part's padded step count (ISSUE 10's "4864-
+# step chain"): the acceptance bar for the width-for-depth variants
+_LEGACY_HARD_PART_STEPS = 4864
+
+
+def test_hard_part_variants_recover_depth():
+    """ISSUE 10 acceptance, satellite 3: the new hard-part variants cut
+    the vmlint critical path below 0.5x the legacy 4864-step chain (the
+    frobenius flagship >= 2.5x), and the pipelined multi-row fold-8 shape
+    is no longer depth-bound — width hides the residual depth."""
+    frob = vm_analysis.analyze_prog(
+        vmlib.build_hard_part_frobenius(1), name="frob", **SHAPE)
+    assert frob["errors"] == 0
+    crit = frob["cost"]["critical_path"]
+    assert crit < 0.5 * _LEGACY_HARD_PART_STEPS
+    assert crit * 2.5 <= _LEGACY_HARD_PART_STEPS  # the >=2.5x flagship bar
+
+    win = vm_analysis.analyze_prog(
+        vmlib.build_hard_part_windowed(1), name="win", **SHAPE)
+    assert win["errors"] == 0
+    assert win["cost"]["critical_path"] < 0.5 * _LEGACY_HARD_PART_STEPS
+
+    # the pipelined multi-row shape (fold 8, the _fold_for cap for the
+    # new variants): classified balanced or width-bound, NOT depth-bound
+    frob8 = vm_analysis.analyze_prog(
+        vmlib.build_hard_part_frobenius(8), name="frob8", **SHAPE)
+    assert frob8["errors"] == 0
+    assert frob8["cost"]["classification"] in ("balanced", "width-bound")
+    # and the depth recovery survives folding: same critical path
+    assert frob8["cost"]["critical_path"] == crit
+
+
 def test_program_stats_cross_checks_the_ir_analysis():
     prog = _chained(24)
     r = vm_analysis.analyze_prog(prog, name="x", **SHAPE)
@@ -213,7 +245,7 @@ def test_tier1_registry_is_sound_and_matches_committed_baseline():
     and confirms bounds for the small-shape registry programs, and their
     pressure/depth scalars match the committed VMLINT_BASELINE.json."""
     reports = vm_analysis.run_registry(tier1_only=True, export=False)
-    assert len(reports) >= 7
+    assert len(reports) >= 9
     for r in reports:
         assert r["errors"] == 0, (r["name"], r["findings"])
         assert r["bounds"]["checked"] > 0
@@ -227,7 +259,7 @@ def test_full_registry_is_sound_and_matches_committed_baseline():
     """Full production shapes (chunk-16 rlc_combine, fold-8 hard part,
     production codec folds): ~20 s of host assembly + analysis."""
     reports = vm_analysis.run_registry(tier1_only=False, export=False)
-    assert len(reports) >= 13
+    assert len(reports) >= 18
     for r in reports:
         assert r["errors"] == 0, (r["name"], r["findings"])
     assert vm_analysis.gate(reports, vm_analysis.load_baseline()) == []

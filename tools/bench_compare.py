@@ -51,6 +51,15 @@ scaling-efficiency ratio are report-only — CPU virtual devices
 timeshare two host cores, so their scaling numbers say nothing until
 real accelerator rounds.
 
+Finalexp gating: rounds that carry a ``finalexp`` section (`bench.py
+--mode finalexp` — per-(variant, rows) hard-part race cells) gate on the
+same state rule: a variant cell that verified in the previous round and
+ERRORS in the newest fails the round outright ("FINALEXP ERRORED",
+mirror of MESH ERRORED — losing a working finalization variant is a
+correctness/availability regression), while ms/row movement — including
+a previously-winning device route going slower than host — is
+report-only.
+
 Output: the comparison table is also emitted as GitHub-flavored markdown
 — appended to ``$GITHUB_STEP_SUMMARY`` when CI sets it, printed to stdout
 otherwise — so the round-over-round numbers land on the workflow summary
@@ -200,6 +209,32 @@ def extract_mesh(doc):
     return out
 
 
+def extract_finalexp(doc):
+    """{``platform:finalexp:<variant,rows>``: {"ok", "ms_per_row"}} from
+    one round's ``finalexp`` section (`bench.py --mode finalexp` hard-part
+    race cells)."""
+    parsed = doc.get("parsed")
+    if not isinstance(parsed, dict) or "error" in parsed:
+        return {}
+    section = parsed.get("finalexp")
+    if not isinstance(section, dict):
+        return {}
+    plat = _platform(parsed)
+    out = {}
+    for name, row in sorted(section.items()):
+        if not isinstance(row, dict) or "ok" not in row:
+            continue
+        try:
+            ms = float(row.get("ms_per_row") or 0.0)
+        except (TypeError, ValueError):
+            ms = 0.0
+        out[f"{plat}:finalexp:{name}"] = {
+            "ok": bool(row.get("ok", False)),
+            "ms_per_row": ms,
+        }
+    return out
+
+
 def _load(path):
     with open(path) as fh:
         return json.load(fh)
@@ -255,6 +290,7 @@ def main(argv=None) -> int:
         new_slo = extract_slo(newest_doc)
         new_sim = extract_sim(newest_doc)
         new_mesh = extract_mesh(newest_doc)
+        new_fx = extract_finalexp(newest_doc)
     except (OSError, ValueError) as e:
         print(f"bench-compare: FAIL — {os.path.basename(newest)} unreadable: {e}")
         return 1
@@ -268,7 +304,8 @@ def main(argv=None) -> int:
         print("bench-compare: SKIP — only one round; nothing to compare")
         return 0
 
-    prev_vals, prev_slo, prev_sim, prev_mesh, prev_path = {}, {}, {}, {}, None
+    prev_vals, prev_slo, prev_sim, prev_mesh = {}, {}, {}, {}
+    prev_fx, prev_path = {}, None
     for path in reversed(files[:-1]):
         try:
             doc = _load(path)
@@ -276,15 +313,17 @@ def main(argv=None) -> int:
             prev_slo = extract_slo(doc)
             prev_sim = extract_sim(doc)
             prev_mesh = extract_mesh(doc)
+            prev_fx = extract_finalexp(doc)
         except (OSError, ValueError):
-            prev_vals, prev_slo, prev_sim, prev_mesh = {}, {}, {}, {}
+            prev_vals, prev_slo, prev_sim = {}, {}, {}
+            prev_mesh, prev_fx = {}, {}
         # an SLO-only or sim-only round (headline errored, objectives or
         # scenario matrix still recorded) is a usable baseline for its
         # state gate even with no throughput number
-        if prev_vals or prev_slo or prev_sim or prev_mesh:
+        if prev_vals or prev_slo or prev_sim or prev_mesh or prev_fx:
             prev_path = path
             break
-    if not prev_vals and not prev_slo and not prev_sim and not prev_mesh:
+    if not (prev_vals or prev_slo or prev_sim or prev_mesh or prev_fx):
         print("bench-compare: SKIP — no earlier round recorded a usable value")
         return 0
 
@@ -292,7 +331,9 @@ def main(argv=None) -> int:
     slo_common = sorted(set(new_slo) & set(prev_slo))
     sim_common = sorted(set(new_sim) & set(prev_sim))
     mesh_common = sorted(set(new_mesh) & set(prev_mesh))
-    if not common and not slo_common and not sim_common and not mesh_common:
+    fx_common = sorted(set(new_fx) & set(prev_fx))
+    if (not common and not slo_common and not sim_common
+            and not mesh_common and not fx_common):
         # SLO keys count as comparables too: two rounds that share no
         # throughput shape but both declare serve_p99 must still gate the
         # objective state, not skip past it
@@ -386,6 +427,30 @@ def main(argv=None) -> int:
         if broke:
             failures.append(key)
 
+    # finalexp state gate: a hard-part variant cell that worked last round
+    # and errors (or returns wrong verdicts) now fails outright — losing a
+    # finalization variant is a correctness/availability regression; the
+    # ms/row movement (including a device route losing to host) is
+    # report-only, exactly like mesh sigs/sec
+    for key in fx_common:
+        old, new = prev_fx[key], new_fx[key]
+        broke = old["ok"] and not new["ok"]
+        status = "FINALEXP ERRORED" if broke else (
+            "ok" if new["ok"] else "still erroring")
+        print(
+            f"  {key}: {old['ms_per_row']:.2f} -> {new['ms_per_row']:.2f} "
+            f"ms/row (ok: {old['ok']} -> {new['ok']})"
+            f"{'  ' + status if broke else ''}"
+        )
+        rows.append((key, f"{old['ms_per_row']:.2f}ms",
+                     f"{new['ms_per_row']:.2f}ms",
+                     (new["ms_per_row"] - old["ms_per_row"])
+                     / old["ms_per_row"]
+                     if old["ms_per_row"] else None,
+                     status))
+        if broke:
+            failures.append(key)
+
     _emit_markdown(rows, os.path.basename(prev_path),
                    os.path.basename(newest), args.max_regression)
     if failures:
@@ -402,6 +467,8 @@ def main(argv=None) -> int:
            if sim_common else "")
         + (f", {len(mesh_common)} mesh device count(s) gated"
            if mesh_common else "")
+        + (f", {len(fx_common)} finalexp cell(s) gated"
+           if fx_common else "")
     )
     return 0
 

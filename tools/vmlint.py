@@ -21,9 +21,10 @@ Exit status 0 = clean. Usage:
     python tools/vmlint.py --json out.json  # dump the full reports
     python tools/vmlint.py --no-gate        # reports only, no baseline diff
 
-Assembly is the dominant cost (~250k ops/sec list scheduling; the chunk-16
-RLC combine alone is ~6-8 s), so the full run takes a minute or two — it
-rides `make check`/CI, not tier-1 pytest (tests analyze the small subset).
+Program building + assembly dominate the run time (the bucketed scheduler
+— ISSUE 10 — assembles at ~1-3M ops/sec, so building the IR is now the
+bigger share); the full registry takes tens of seconds and rides
+`make check`/CI, not tier-1 pytest (tests analyze the small subset).
 """
 import argparse
 import json
