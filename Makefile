@@ -20,7 +20,7 @@ CHEAP_GENERATORS = shuffling bls ssz_generic merkle
         detect_generator_incomplete check_vectors bench serve-bench codec-bench multichip \
         clean_vectors generate_random_tests bench-compare check serve-trace head-bench docs \
         sim-bench sim-smoke serve-bench-mesh mesh-smoke clean rlc-bench \
-        finalexp-bench finalexp-smoke native sweep
+        finalexp-bench finalexp-smoke native sweep serve-fleet-bench fleet-smoke
 
 # fast default: BLS stubbed except @always_bls, 4-way process-parallel
 # (reference `make test` = pytest -n 4, reference Makefile:100)
@@ -143,6 +143,29 @@ serve-trace:
 serve-bench-mesh:
 	JAX_PLATFORMS=cpu python bench.py --mode serve-mesh
 
+# multi-process fleet scaling sweep (ISSUE 11): one FleetRouter fleet of
+# real worker PROCESSES per worker count (SERVE_FLEET_WORKERS, default
+# 1,2,4 — counts past the 2 physical cores are report-only), each worker
+# warmed at exactly the flush shapes its consistent-hash share of the
+# stream produces; the JSON line's `fleet` section carries aggregate
+# sigs/sec per count plus the merged-scrape exactness property (merged
+# /metrics == exact merge of per-worker snapshots: observation counts
+# sum, bucket mass sums). tools/bench_compare.py gates the ok-STATE
+# ("FLEET ERRORED"); sigs/sec and the 2-worker speedup are report-only.
+serve-fleet-bench:
+	JAX_PLATFORMS=cpu python bench.py --mode serve-fleet
+
+# fleet control-plane canary (CI, mirror of mesh-smoke): a 2-worker fleet
+# through the strict verdict-identity gate (fleet == single-process
+# service == host oracle over valid/corrupted/malformed/infinity), then
+# one forced worker fault under load must produce an SLO burn-rate-driven
+# shed/drain decision reconstructable end-to-end from the merged flight
+# journal (decision + worker provenance + ladder transition) and a
+# merged-scrape delta; journal dumps to fleet_flight.jsonl (CI artifact
+# on failure). Out of tier-1: the workers pay real-backend compiles.
+fleet-smoke:
+	JAX_PLATFORMS=cpu python -m consensus_specs_tpu.serve.fleet_smoke
+
 # mesh convergence canary (CI): one serve flush on a 4-virtual-device
 # mesh through the STRICT verdict-identity gate (mesh == single-device ==
 # host oracle over valid/corrupted/malformed/infinity inputs, bisection
@@ -229,7 +252,9 @@ clean_vectors:
 # tree reproducible after `make serve-trace` / `sim-bench` / `mesh-smoke`)
 clean:
 	rm -rf serve_trace.json serve_flight.jsonl flight_dump.jsonl \
-		mesh_flight.jsonl finalexp_flight.jsonl sim_flight/
+		mesh_flight.jsonl finalexp_flight.jsonl sim_flight/ \
+		fleet_flight.jsonl serve_flight.*.jsonl flight_dump.*.jsonl \
+		mesh_flight.*.jsonl finalexp_flight.*.jsonl fleet_flight.*.jsonl
 
 # build the native kernels (csrc/): batched-SHA256 merkleization and the
 # VM assembler's scheduling+allocation kernel (ops/vm.py loads it via

@@ -66,6 +66,17 @@ child per device count (SERVE_MESH_DEVICES, default 1,2,4,8), emitting a
 fallbacks, efficiency vs single-device — that tools/bench_compare.py
 gates on ok-state round over round (`make serve-bench-mesh`).
 
+`--mode serve-fleet` is the multi-process fleet sweep (ISSUE 11): one
+`serve/fleet.FleetRouter` fleet of REAL worker processes per worker
+count (SERVE_FLEET_WORKERS, default 1,2,4), each worker core-pinned and
+warmed at exactly the flush shapes its consistent-hash share of the
+stream produces; the `fleet` JSON section carries aggregate sigs/sec per
+count plus the merged-scrape exactness property (merged /metrics ==
+exact merge of per-worker snapshots) and is state-gated round over
+round by tools/bench_compare.py ("FLEET ERRORED"). The parent pays the
+jax import (ops/__init__ loads it eagerly) but never does device work
+or compiles — those happen only in the core-pinned workers.
+
 `--mode codec` is the prep-only microbenchmark: the batched input codec
 (ops/codec.py) vs the per-item pure-Python prep path, items/sec over
 CODEC_ITEMS items per kind — no pairings, just the front-door cost.
@@ -482,12 +493,24 @@ def main():
     if _cli_mode() == "serve-mesh":
         # mesh scaling sweep: one serve-bench child per device count (the
         # virtual-device count is frozen at backend init, so counts can't
-        # share a process); the parent never imports jax. The `mesh`
+        # share a process); the parent does no device work. The `mesh`
         # section is gated round-over-round by tools/bench_compare.py —
         # a device count that verified and now errors fails the round.
         from consensus_specs_tpu.serve.load import run_serve_mesh_sweep
 
         _emit_result(run_serve_mesh_sweep())
+        return
+
+    if _cli_mode() == "serve-fleet":
+        # multi-process fleet scaling sweep (ISSUE 11): one FleetRouter
+        # per worker count, real worker PROCESSES (each its own GIL/XLA
+        # client), aggregate sigs/sec + the merged-scrape exactness
+        # property in a `fleet` section gated state-wise by
+        # tools/bench_compare.py. The parent imports jax (ops/__init__
+        # is eager) but all device work happens in the workers.
+        from consensus_specs_tpu.bench.fleet_sweep import run_fleet_bench
+
+        _emit_result(run_fleet_bench())
         return
 
     if _cli_mode() == "codec":

@@ -27,7 +27,14 @@ it exports three surfaces:
                   serve/chain/vm events, JSONL dump on fault/demand).
 - ``slo``       — declared latency objectives + multi-window burn rates
                   over the histograms; feeds ``/healthz`` and the bench
-                  JSON ``slo`` sections ``bench_compare`` gates.
+                  JSON ``slo`` sections ``bench_compare`` gates — plus
+                  the fleet ``ShedPolicy`` (burn rates -> shed/drain
+                  decisions, ISSUE 11).
+- ``snapshot``  — the cross-process wire format: a worker's whole obs
+                  state (histograms, stats, gauges, flight journal) as
+                  one JSON-safe dict, round-trip-merge-exact.
+- ``fleet``     — the ``FleetAggregator`` merging N worker snapshots
+                  into one exact fleet-wide metrics/journal surface.
 
 Import cost is stdlib-only; nothing here imports jax, and ``ops`` modules
 are only reached lazily at render/record time (so ops <-> obs never

@@ -43,28 +43,42 @@ class _Handler(BaseHTTPRequestHandler):
         path = self.path.split("?", 1)[0]
         try:
             if path == "/metrics":
-                body = registry.render_prometheus().encode()
+                # a metrics_fn override swaps the body source (the fleet
+                # router serves its aggregator's MERGED cross-process
+                # render here); the default is this process's registry
+                fn = self.server.metrics_fn
+                body = (fn() if fn is not None
+                        else registry.render_prometheus()).encode()
                 ctype = "text/plain; version=0.0.4; charset=utf-8"
             elif path == "/snapshot":
                 body = json.dumps(self.server.snapshot_fn(),
                                   sort_keys=True).encode()
                 ctype = "application/json"
             elif path == "/healthz":
-                from . import slo
+                fn = self.server.healthz_fn
+                if fn is not None:
+                    payload = fn()
+                else:
+                    from . import slo
 
-                body = json.dumps(slo.global_tracker().healthz(),
-                                  sort_keys=True).encode()
+                    payload = slo.global_tracker().healthz()
+                body = json.dumps(payload, sort_keys=True).encode()
                 ctype = "application/json"
             elif path == "/flightdump":
-                from . import flight
+                fn = self.server.flight_fn
+                if fn is not None:
+                    body = fn().encode()
+                else:
+                    from . import flight
 
-                rec = flight.maybe_recorder()
-                if rec is None:
-                    self.send_error(
-                        404, "flight recorder disabled "
-                        "(set CONSENSUS_SPECS_TPU_FLIGHT=1)")
-                    return
-                body = rec.to_jsonl(reason="flightdump_endpoint").encode()
+                    rec = flight.maybe_recorder()
+                    if rec is None:
+                        self.send_error(
+                            404, "flight recorder disabled "
+                            "(set CONSENSUS_SPECS_TPU_FLIGHT=1)")
+                        return
+                    body = rec.to_jsonl(
+                        reason="flightdump_endpoint").encode()
                 ctype = "application/x-ndjson"
             else:
                 self.send_error(404, "unknown path")
@@ -89,10 +103,17 @@ class ExpositionServer:
     """A bound-and-serving exposition endpoint on a daemon thread."""
 
     def __init__(self, snapshot_fn=None, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, metrics_fn=None, healthz_fn=None,
+                 flight_fn=None):
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
         self._httpd.snapshot_fn = snapshot_fn or _default_snapshot
+        # per-route body overrides (None = this process's default source);
+        # the fleet router passes its aggregator's merged render/healthz/
+        # journal so ONE endpoint class serves both shapes
+        self._httpd.metrics_fn = metrics_fn
+        self._httpd.healthz_fn = healthz_fn
+        self._httpd.flight_fn = flight_fn
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="obs-exposition",
             daemon=True,
@@ -121,10 +142,14 @@ class ExpositionServer:
 
 
 def start_exposition(metrics=None, snapshot_fn=None, host: str = "127.0.0.1",
-                     port: int = 0) -> ExpositionServer:
+                     port: int = 0, metrics_fn=None, healthz_fn=None,
+                     flight_fn=None) -> ExpositionServer:
     """Start the endpoint. ``metrics`` is a ``ServeMetrics`` (its
     ``snapshot`` becomes ``/snapshot``); ``snapshot_fn`` overrides; with
-    neither, ``/snapshot`` serves the profiling summary."""
+    neither, ``/snapshot`` serves the profiling summary. The ``*_fn``
+    overrides swap a route's body source (fleet-merged rendering)."""
     if snapshot_fn is None and metrics is not None:
         snapshot_fn = metrics.snapshot
-    return ExpositionServer(snapshot_fn=snapshot_fn, host=host, port=port)
+    return ExpositionServer(snapshot_fn=snapshot_fn, host=host, port=port,
+                            metrics_fn=metrics_fn, healthz_fn=healthz_fn,
+                            flight_fn=flight_fn)

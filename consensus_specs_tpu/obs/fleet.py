@@ -1,0 +1,222 @@
+"""Fleet aggregator: N worker snapshots merged into ONE observability
+surface.
+
+The serve fleet (ISSUE 11) runs one ``VerificationService`` process per
+device group; each worker ships `obs/snapshot.py` wire snapshots over the
+worker protocol, and this module folds them into the single fleet-wide
+view the router's ``/metrics`` + ``/healthz`` + ``/flightdump`` endpoints
+serve:
+
+- **histograms** merge exactly (`hist.py` fixed bounds: bucket counts
+  add), keyed by their bare label — the fleet's
+  ``serve.submit_to_result`` IS the sum of every worker's, which is what
+  lets `obs/slo.py` compute burn rates on merged bucket mass;
+- **stat accumulators** merge by summing calls/seconds (max of max) —
+  each worker observed disjoint calls;
+- **gauges** split by plane: ``serve.*`` / ``chain.*`` instance gauges
+  re-scope per worker through ``registry.node_label`` (the simnet
+  ``serve[<node>].*`` family — ``serve[w0].queue_depth`` and
+  ``serve[w1].queue_depth`` publish side by side instead of clobbering),
+  counter-like gauges from the other planes (``bls.*``, ``flight.*``,
+  ``device.*``, ``hist.*``, ``vm.*``) SUM across workers, and worker
+  ``slo.*`` gauges are dropped — the fleet recomputes objective state
+  from the MERGED histograms (`serve/fleet.py`), never averages worker
+  verdicts;
+- **flight journals** merge incrementally: every ingest appends only the
+  events past the worker's last-seen sequence number, each stamped with
+  its worker label, so the merged journal is the fleet's black box —
+  a shed decision in the router and the ladder transition it caused in
+  the worker reconstruct side by side.
+
+The merged exposition is just ``registry.render_prometheus`` over the
+merged (stats, gauges, hists) triple — one renderer, one text format,
+whether the process behind ``/metrics`` is a lone service or a fleet.
+"""
+import json
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from . import registry, snapshot
+from .hist import Histogram
+
+# worker gauges under these planes re-scope per worker via node_label
+# (the registered serve[/chain[ dynamic families); everything else is a
+# process-wide counter-style gauge that sums across the fleet
+_INSTANCE_PLANES = ("serve.", "chain.")
+# recomputed fleet-side from merged histograms, never merged from workers
+_DROP_PREFIXES = ("slo.",)
+
+
+class FleetAggregator:
+    """Merge-point for worker observability snapshots.
+
+    ``ingest`` keeps the LATEST snapshot per worker (snapshots are
+    cumulative process state, not deltas — merging the latest from each
+    worker is exact) and appends newly-seen flight events to the merged
+    journal. All reads build fresh merged structures; nothing here holds
+    references into a worker's live state.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._snaps: Dict[str, Dict] = {}
+        self._journal: List[Dict] = []
+        self._last_seq: Dict[str, int] = {}
+        self.ingests = 0
+
+    # -- ingest ---------------------------------------------------------------
+
+    def ingest(self, worker: str, snap: Dict) -> None:
+        """Store ``worker``'s latest snapshot (wire-version-checked) and
+        absorb its new flight events into the merged journal."""
+        snapshot.check_version(snap)
+        with self._lock:
+            self._snaps[worker] = snap
+            self.ingests += 1
+            flight = snap.get("flight")
+            if flight:
+                last = self._last_seq.get(worker, 0)
+                for event in flight.get("events", ()):
+                    seq = int(event.get("seq", 0))
+                    if seq > last:
+                        stamped = dict(event)
+                        stamped.setdefault("node", worker)
+                        stamped["worker"] = worker
+                        self._journal.append(stamped)
+                        self._last_seq[worker] = seq
+
+    def last_seq(self, worker: str) -> int:
+        """Highest flight-event sequence number already merged from
+        ``worker`` — the router passes it back as ``flight_since`` so
+        steady-state snapshots ship journal deltas, not the full ring."""
+        with self._lock:
+            return self._last_seq.get(worker, 0)
+
+    # -- merged reads ---------------------------------------------------------
+
+    @property
+    def workers(self) -> List[str]:
+        with self._lock:
+            return sorted(self._snaps)
+
+    def worker_snapshot(self, worker: str) -> Optional[Dict]:
+        with self._lock:
+            return self._snaps.get(worker)
+
+    def worker_hists(self, worker: str) -> Dict[str, Histogram]:
+        """One worker's latency histograms, decoded (per-worker SLO burn
+        attribution reads these)."""
+        with self._lock:
+            snap = self._snaps.get(worker)
+        if snap is None:
+            return {}
+        return {label: snapshot.hist_from_wire(w)
+                for label, w in snap.get("hists", {}).items()}
+
+    def merged_hists(self) -> Dict[str, Histogram]:
+        """Exact fleet-wide histograms: per label, the merge of every
+        worker's wire histogram (observation counts sum, bucket mass
+        sums — the property `tests/test_obs_hist.py` pins)."""
+        with self._lock:
+            snaps = list(self._snaps.values())
+        by_label: Dict[str, List[Dict]] = {}
+        for snap in snaps:
+            for label, wire in snap.get("hists", {}).items():
+                by_label.setdefault(label, []).append(wire)
+        return {label: snapshot.merge_hist_wires(wires)
+                for label, wires in sorted(by_label.items())}
+
+    def merged_stats(self) -> Dict[str, Dict]:
+        with self._lock:
+            snaps = list(self._snaps.values())
+        by_label: Dict[str, List[Dict]] = {}
+        for snap in snaps:
+            for label, entry in snap.get("stats", {}).items():
+                by_label.setdefault(label, []).append(entry)
+        return {label: snapshot.merge_stat_entries(entries)
+                for label, entries in sorted(by_label.items())}
+
+    def merged_gauges(self) -> Dict[str, float]:
+        """Worker gauges under the fleet merge rule (module docstring):
+        instance planes re-scope per worker, counters sum, slo.* drops."""
+        with self._lock:
+            items = sorted(self._snaps.items())
+        out: Dict[str, float] = {}
+        for worker, snap in items:
+            for label, value in snap.get("gauges", {}).items():
+                if label.startswith(_DROP_PREFIXES):
+                    continue
+                if label.startswith(_INSTANCE_PLANES) and "[" not in label:
+                    out[registry.node_label(label, worker)] = value
+                else:
+                    out[label] = out.get(label, 0.0) + value
+        return out
+
+    def merged_view(self, local_stats: Optional[Dict] = None,
+                    local_gauges: Optional[Dict] = None
+                    ) -> Tuple[Dict, Dict, Dict]:
+        """The (stats, gauges, hists) triple the Prometheus renderer
+        consumes. ``local_*`` overlay the aggregator process's own state
+        on top of the worker merge — but only where the router is the
+        authority: ``fleet.*`` / ``slo.*`` gauges replace (they are
+        router-computed), unknown keys add, and any other collision
+        keeps the WORKER sum (e.g. the router dumping its own flight
+        journal sets a local ``flight.events`` that must not clobber the
+        fleet-summed counter — the merged scrape stays the exact merge)."""
+        stats = self.merged_stats()
+        gauges = self.merged_gauges()
+        if local_stats:
+            for label, entry in local_stats.items():
+                stats[label] = (snapshot.merge_stat_entries(
+                    [stats[label], entry]) if label in stats else entry)
+        if local_gauges:
+            for label, value in local_gauges.items():
+                if label.startswith(("fleet.", "slo.")) or label not in gauges:
+                    gauges[label] = value
+        return stats, gauges, self.merged_hists()
+
+    def render_metrics(self, local_stats: Optional[Dict] = None,
+                       local_gauges: Optional[Dict] = None) -> str:
+        """The fleet-wide ``/metrics`` body: the standard Prometheus
+        renderer over the merged triple."""
+        stats, gauges, hists = self.merged_view(local_stats, local_gauges)
+        return registry.render_prometheus(stats=stats, gauges=gauges,
+                                          hists=hists)
+
+    # -- merged journal -------------------------------------------------------
+
+    def journal_events(self, local_recorder=None) -> List[Dict]:
+        """The merged flight journal: every worker's ingested events plus
+        (when given) the aggregator process's own recorder — the router's
+        shed/drain decisions interleaved with the worker transitions they
+        caused. Ordered by ingest for workers, with local events appended
+        in ring order (clocks are per-process perf counters and do not
+        share an epoch; ``seq`` + provenance are the reconstruction keys,
+        not ``t``)."""
+        with self._lock:
+            events = [dict(e) for e in self._journal]
+        if local_recorder is not None:
+            for e in local_recorder.events():
+                stamped = dict(e)
+                stamped["worker"] = stamped.get("node", "router")
+                stamped.setdefault("node", "router")
+                events.append(stamped)
+        return events
+
+    def journal_jsonl(self, local_recorder=None,
+                      reason: str = "fleet_dump") -> str:
+        """The merged journal as JSONL (one header line + one event per
+        line) — the ``/flightdump`` body and the CI failure artifact."""
+        events = self.journal_events(local_recorder)
+        header = {
+            "flight": "fleet-v1",
+            "reason": reason,
+            "workers": self.workers,
+            "events": len(events),
+        }
+        lines = [json.dumps(header, sort_keys=True)]
+        for e in events:
+            if isinstance(e.get("t"), float):
+                e["t"] = round(e["t"], 6)
+            lines.append(json.dumps(e, sort_keys=True, default=repr))
+        return "\n".join(lines) + "\n"

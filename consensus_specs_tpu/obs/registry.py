@@ -49,6 +49,20 @@ GAUGES: Dict[str, str] = {
                           "single-device path; CONSENSUS_SPECS_TPU_MESH)",
     "serve.mesh_fallbacks": "mesh-sharded verify attempts that degraded to "
                             "the single-device path (ladder rung 0)",
+    "serve.ladder_rung": "commanded degradation-ladder rung for the "
+                         "service (0 = RLC combine, 1 = per-group batched, "
+                         "2 = sequential oracle; the fleet router's shed "
+                         "decisions move it)",
+    "fleet.workers": "live worker processes behind the fleet router "
+                     "(drained workers leave the ring and this count)",
+    "fleet.snapshots": "per-worker observability snapshots the fleet "
+                       "aggregator has merged",
+    "fleet.requests": "requests the fleet router has routed to workers "
+                      "(consistent-hash result-cache affinity)",
+    "fleet.sheds": "SLO-burn-driven shed decisions (a worker commanded "
+                   "one rung down the RLC->per-group->oracle ladder)",
+    "fleet.drains": "SLO-burn-driven drain decisions (a worker removed "
+                    "from the ring and drained)",
     "bls.prep_pool_broken": "1 when the prewarm process pool has latched "
                             "broken (reset_prep_state() clears)",
     "bls.prep_serial_fallback_items": "items that degraded to serial "
@@ -219,8 +233,13 @@ def _series(name: str, label_value, value) -> str:
     return f'{name}{{label="{_escape(label_value)}"}} {value}'
 
 
-def render_prometheus() -> str:
-    """Prometheus text format 0.0.4 over the live profiling snapshot.
+def render_prometheus(stats=None, gauges=None, hists=None) -> str:
+    """Prometheus text format 0.0.4 over the live profiling snapshot —
+    or, when the (``stats``, ``gauges``, ``hists``) triple is passed
+    explicitly, over that state instead: the fleet aggregator
+    (``obs/fleet.py``) renders its MERGED cross-process view through this
+    exact renderer, so a fleet scrape and a single-process scrape share
+    one text format and one family naming scheme.
 
     Stat accumulators render as ``_calls_total``/``_seconds_total``
     counters + a ``_max_seconds`` gauge; latency histograms render TWICE —
@@ -232,15 +251,19 @@ def render_prometheus() -> str:
     emitted once per family even when dynamic labels fan it out into many
     series.
     """
-    from ..ops import profiling
+    if stats is None and gauges is None and hists is None:
+        from ..ops import profiling
 
-    # three one-lock reads, ONE histogram snapshot per latency family:
-    # the summary quantile lines and the histogram lines below derive
-    # from the same detached copy, so the two families always agree on
-    # count/sum within a single scrape (profiling.summary() would build
-    # its own percentile summaries just to be thrown away here)
-    stats, gauges = profiling.stats_and_gauges()
-    lat_hists = profiling.latency_histograms()
+        # three one-lock reads, ONE histogram snapshot per latency family:
+        # the summary quantile lines and the histogram lines below derive
+        # from the same detached copy, so the two families always agree on
+        # count/sum within a single scrape (profiling.summary() would build
+        # its own percentile summaries just to be thrown away here)
+        stats, gauges = profiling.stats_and_gauges()
+        hists = profiling.latency_histograms()
+    stats = stats or {}
+    gauges = gauges or {}
+    lat_hists = hists or {}
     entries = {label: ("stat", v) for label, v in stats.items()}
     entries.update({label: ("lat", h) for label, h in lat_hists.items()})
     entries.update({label: ("gauge", v) for label, v in gauges.items()})

@@ -112,12 +112,21 @@ class SloTracker:
 
     # -- evaluation ----------------------------------------------------------
 
-    def evaluate(self) -> Dict[str, Dict]:
+    def evaluate(self, hists=None, export: bool = True) -> Dict[str, Dict]:
         """Current objective state + burn rates; also records a checkpoint
-        and publishes the ``slo.*`` gauges."""
-        from ..ops import profiling
+        and (by default) publishes the ``slo.*`` gauges.
 
-        hists = profiling.latency_histograms()
+        ``hists`` overrides the histogram source: the default is THIS
+        process's ``profiling.latency_histograms()``, but the fleet
+        router passes its aggregator's MERGED cross-process histograms —
+        fleet burn rates are computed on exact fleet-wide bucket mass,
+        not on any one worker's view. Per-worker attribution trackers
+        pass each worker's own decoded histograms with ``export=False``
+        so they never stomp the fleet-level ``slo.*`` gauges."""
+        if hists is None:
+            from ..ops import profiling
+
+            hists = profiling.latency_histograms()
         now = self._clock()
         counts: Dict[str, Tuple[int, int]] = {}
         out: Dict[str, Dict] = {}
@@ -168,7 +177,8 @@ class SloTracker:
                     or now - self._checkpoints[-1][0]
                     >= self._CHECKPOINT_SPACING):
                 self._checkpoints.append((now, counts))
-        self._export_gauges(out)
+        if export:
+            self._export_gauges(out)
         return out
 
     def _export_gauges(self, evaluated: Dict[str, Dict]) -> None:
@@ -212,6 +222,108 @@ class SloTracker:
                 row["margin"] = e["margin"]
             section[name] = row
         return section
+
+
+# -- fleet shed policy (ISSUE 11) ---------------------------------------------
+#
+# The first time the obs plane CLOSES the loop from measurement to
+# control: the fleet router computes burn rates on the MERGED worker
+# histograms (evaluate(hists=...) above) and feeds them through this
+# policy — the decision is which worker to push one rung down the
+# existing RLC -> per-group -> oracle degradation ladder (shed), or to
+# remove from the ring entirely (drain), when a window burns.
+
+SHED_BURN_ENV = "CONSENSUS_SPECS_TPU_FLEET_SHED_BURN"
+DRAIN_BURN_ENV = "CONSENSUS_SPECS_TPU_FLEET_DRAIN_BURN"
+
+# burn-rate thresholds (multiples of the sustainable error-budget rate):
+# 1.0 drains the budget exactly on schedule; the defaults page well past
+# noise — shed at 4x, drain at 32x or when a shed-to-the-bottom worker
+# keeps burning. Env-tunable without code, like the objectives above.
+DEFAULT_SHED_BURN = 4.0
+DEFAULT_DRAIN_BURN = 32.0
+
+
+def worst_burn(evaluated: Dict[str, Dict]):
+    """(objective name, window key, rate) of the highest burn rate in an
+    ``evaluate()`` result — (None, None, 0.0) when nothing burns."""
+    worst = (None, None, 0.0)
+    for name, entry in sorted(evaluated.items()):
+        for window, rate in sorted(entry.get("burn_rate", {}).items()):
+            if rate > worst[2]:
+                worst = (name, window, rate)
+    return worst
+
+
+class ShedDecision:
+    """One policy verdict: ``action`` ("shed" | "drain") against
+    ``worker``, with the burn evidence that justified it (objective,
+    window, rate) — exactly what the router journals as the fleet
+    flight event."""
+
+    __slots__ = ("worker", "action", "objective", "window", "burn")
+
+    def __init__(self, worker, action, objective, window, burn):
+        self.worker = worker
+        self.action = action
+        self.objective = objective
+        self.window = window
+        self.burn = burn
+
+    def as_dict(self) -> Dict:
+        return {"worker": self.worker, "action": self.action,
+                "objective": self.objective, "window": self.window,
+                "burn": round(self.burn, 4)}
+
+    def __repr__(self):
+        return (f"ShedDecision({self.action} {self.worker}: "
+                f"{self.objective}/{self.window} burn {self.burn:.1f}x)")
+
+
+class ShedPolicy:
+    """Multi-window burn rates -> load-shedding decisions.
+
+    ``decide`` looks at the FLEET evaluation first (is any window burning
+    past the shed threshold at all?), then attributes: the worker whose
+    own histograms show the worst burn is the one acted on. Escalation:
+    a burn past ``drain_burn`` — or a shed-to-the-bottom worker (ladder
+    rung 2) still burning past ``shed_burn`` — drains; anything else
+    past ``shed_burn`` sheds one rung. At most ONE decision per call:
+    shedding changes the system, so the next control tick re-measures
+    before anything else moves (the router adds a per-worker hold-down
+    on top, since burn windows look back past the action)."""
+
+    def __init__(self, shed_burn: Optional[float] = None,
+                 drain_burn: Optional[float] = None):
+        if shed_burn is None:
+            shed_burn = float(os.environ.get(SHED_BURN_ENV,
+                                             str(DEFAULT_SHED_BURN)))
+        if drain_burn is None:
+            drain_burn = float(os.environ.get(DRAIN_BURN_ENV,
+                                              str(DEFAULT_DRAIN_BURN)))
+        self.shed_burn = shed_burn
+        self.drain_burn = max(drain_burn, shed_burn)
+
+    def decide(self, fleet_eval: Dict[str, Dict],
+               worker_evals: Dict[str, Dict[str, Dict]],
+               rungs: Optional[Dict[str, int]] = None
+               ) -> List[ShedDecision]:
+        rungs = rungs or {}
+        _, _, fleet_rate = worst_burn(fleet_eval)
+        if fleet_rate < self.shed_burn:
+            return []
+        # attribution: the worker whose own burn is worst (ties break by
+        # label order — deterministic)
+        target, t_obj, t_window, t_rate = None, None, None, 0.0
+        for worker, evaluated in sorted(worker_evals.items()):
+            obj, window, rate = worst_burn(evaluated)
+            if rate > t_rate:
+                target, t_obj, t_window, t_rate = worker, obj, window, rate
+        if target is None or t_rate < self.shed_burn:
+            return []  # fleet-level burn with no attributable worker
+        action = ("drain" if t_rate >= self.drain_burn
+                  or rungs.get(target, 0) >= 2 else "shed")
+        return [ShedDecision(target, action, t_obj, t_window, t_rate)]
 
 
 # -- process-global tracker ---------------------------------------------------

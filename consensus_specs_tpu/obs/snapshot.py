@@ -1,0 +1,142 @@
+"""Observability snapshot wire format: what a fleet worker ships home.
+
+Every metric type in the obs plane was designed for exact cross-process
+aggregation — `hist.py` histograms merge by adding fixed-bound bucket
+counts, stat accumulators merge by summing calls/seconds, flight events
+carry their own sequence numbers — but until the serve fleet (ISSUE 11)
+nothing ever crossed a real process boundary. This module is that
+boundary's codec: a worker process serializes its whole observability
+state to ONE JSON-safe dict (`take_process_snapshot`), ships it over the
+worker protocol (`serve/worker.py`), and the fleet aggregator
+(`obs/fleet.py`) deserializes and merges it bit-identically to what an
+in-process merge of the same histograms would produce — the round-trip
+property `tests/test_obs_hist.py` gates:
+
+    merge(from_wire(to_wire(a)), from_wire(to_wire(b)))
+        == merge(a, b)          (bucket counts, count, sum, min, max)
+
+JSON is the carrier (the worker protocol is ndjson over pipes), so the
+sparse bucket dict's int keys become strings on the wire and are restored
+on decode; float fields survive exactly (Python's json round-trips float
+repr losslessly).
+"""
+import os
+from typing import Dict, List, Optional
+
+from . import hist
+
+# wire version: a worker and an aggregator from different builds refuse
+# to merge silently-incompatible state (bump on any layout change)
+WIRE_VERSION = 1
+
+
+class WireError(ValueError):
+    """A snapshot that cannot be decoded (wrong version / malformed)."""
+
+
+# -- histogram codec ----------------------------------------------------------
+
+
+def hist_to_wire(h: hist.Histogram) -> Dict:
+    """One histogram as a JSON-safe dict (sparse counts, str bucket keys)."""
+    st = h.state()
+    return {
+        "counts": {str(idx): n for idx, n in st["counts"].items()},
+        "count": st["count"],
+        "sum": st["sum"],
+        "min": st["min"],
+        "max": st["max"],
+    }
+
+
+def hist_from_wire(wire: Dict) -> hist.Histogram:
+    """Inverse of :func:`hist_to_wire`; the reconstructed histogram is
+    state-identical to the source (same buckets, count, sum, extremes)."""
+    try:
+        h = hist.Histogram()
+        h._counts = {int(idx): int(n) for idx, n in wire["counts"].items()}
+        h.count = int(wire["count"])
+        h.sum = float(wire["sum"])
+        h.min = None if wire.get("min") is None else float(wire["min"])
+        h.max = None if wire.get("max") is None else float(wire["max"])
+        return h
+    except (KeyError, TypeError, ValueError, AttributeError) as e:
+        raise WireError(f"malformed histogram wire dict: {e}") from e
+
+
+# -- whole-process snapshot ---------------------------------------------------
+
+
+def take_process_snapshot(worker: Optional[str] = None,
+                          extra: Optional[Dict] = None,
+                          flight_since: int = 0) -> Dict:
+    """The process's full observability state as one JSON-safe dict:
+    latency histograms (wire form), stat accumulators, gauges, and — when
+    the flight recorder is armed — the journal ring with its counters.
+    ``worker`` stamps the snapshot (the fleet label); ``extra`` attaches
+    caller payload (e.g. the worker's ``ServeMetrics.snapshot()``);
+    ``flight_since`` ships only flight events with ``seq`` past it (the
+    fleet control tick passes its last merged seq so the steady-state
+    snapshot carries deltas, not the whole 4096-event ring — counters
+    stay cumulative either way)."""
+    from ..ops import profiling
+
+    from . import flight
+
+    stats, gauges = profiling.stats_and_gauges()
+    snap = {
+        "v": WIRE_VERSION,
+        "worker": worker,
+        "pid": os.getpid(),
+        "stats": stats,
+        "gauges": gauges,
+        "hists": {label: hist_to_wire(h)
+                  for label, h in profiling.latency_histograms().items()},
+    }
+    rec = flight.maybe_recorder()
+    if rec is not None:
+        events = rec.events()
+        if flight_since:
+            events = [e for e in events
+                      if int(e.get("seq", 0)) > int(flight_since)]
+        snap["flight"] = {
+            "counters": rec.counters(),
+            "events": events,
+        }
+    if extra:
+        snap["extra"] = extra
+    return snap
+
+
+def check_version(snap: Dict) -> Dict:
+    """Validate a decoded snapshot's wire version; returns it unchanged."""
+    v = snap.get("v") if isinstance(snap, dict) else None
+    if v != WIRE_VERSION:
+        raise WireError(
+            f"snapshot wire version {v!r} != supported {WIRE_VERSION}")
+    return snap
+
+
+# -- merge primitives (exact, commutative, associative) -----------------------
+
+
+def merge_hist_wires(wires: List[Dict]) -> hist.Histogram:
+    """Merge any number of wire-form histograms into one Histogram —
+    exactly the in-process ``Histogram.merge`` fold over the decoded
+    inputs (which is what the round-trip property test pins)."""
+    out = hist.Histogram()
+    for w in wires:
+        out = out.merge(hist_from_wire(w))
+    return out
+
+
+def merge_stat_entries(entries: List[Dict]) -> Dict:
+    """Stat-accumulator merge: calls and total seconds SUM (each process
+    observed disjoint calls), max is the max — same algebra the in-process
+    accumulator applies one observation at a time."""
+    out = {"calls": 0, "total_s": 0.0, "max_s": 0.0}
+    for e in entries:
+        out["calls"] += int(e.get("calls", 0))
+        out["total_s"] += float(e.get("total_s", 0.0))
+        out["max_s"] = max(out["max_s"], float(e.get("max_s", 0.0)))
+    return out

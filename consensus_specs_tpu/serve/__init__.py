@@ -7,8 +7,16 @@ CONSENSUS_SPECS_TPU_RLC=0 reverts to (kind, K-bucket) grouped batched
 calls, the fallback ladder either way ending at the pure-Python oracle)
 -> content-keyed result cache + in-flight dedup.
 See service.py for the dataflow and COMPONENTS.md's "Serve plane" row.
+
+The fleet tier (ISSUE 11) promotes this plane to N worker PROCESSES:
+``fleet.FleetRouter`` spawns one ``worker.py`` service process per
+device group, routes by consistent-hash content key, merges every
+worker's observability snapshot into one ``/metrics`` surface
+(``obs/fleet.py``), and sheds load down the RLC -> per-group -> oracle
+ladder from SLO burn rates on the MERGED histograms.
 """
 from .cache import ResultCache, check_key  # noqa: F401
+from .fleet import FleetRouter, HashRing, WorkerHandle  # noqa: F401
 from .metrics import ServeMetrics  # noqa: F401
 from .service import (  # noqa: F401
     QueueFull,

@@ -61,6 +61,7 @@ class ServeMetrics:
         self._occ_lanes_label = node_label("serve.occupancy_lanes", node)
         self._mesh_devices_label = node_label("serve.mesh_devices", node)
         self._mesh_fallbacks_label = node_label("serve.mesh_fallbacks", node)
+        self._ladder_rung_label = node_label("serve.ladder_rung", node)
         self._lock = threading.Lock()
         self.submits = 0
         self.eager = 0  # resolved at submit time by the reference's own rules
@@ -81,6 +82,8 @@ class ServeMetrics:
         # the single-device path (degradation-ladder rung 0)
         self.mesh_devices = 0
         self.mesh_fallbacks = 0
+        # commanded degradation-ladder rung (ISSUE 11 load shedding)
+        self.ladder_rung = 0
         # prep-vs-device time split (the two pipeline stages): where a
         # flush's wall time goes — host codec prep or the device hard
         # part. device_flushes counts whole flushes (like prep_batches)
@@ -153,6 +156,12 @@ class ServeMetrics:
         with self._lock:
             self.mesh_devices = n_devices
         profiling.set_gauge(self._mesh_devices_label, n_devices)
+
+    def note_ladder(self, rung: int) -> None:
+        """Record the commanded degradation-ladder rung (shed control)."""
+        with self._lock:
+            self.ladder_rung = rung
+        profiling.set_gauge(self._ladder_rung_label, rung)
 
     def note_mesh_fallback(self) -> None:
         with self._lock:
@@ -247,6 +256,7 @@ class ServeMetrics:
                 "fallback_items": self.fallback_items,
                 "mesh_devices": self.mesh_devices,
                 "mesh_fallbacks": self.mesh_fallbacks,
+                "ladder_rung": self.ladder_rung,
                 "queue_depth_peak": self.queue_depth_peak,
                 "prep_batches": self.prep_batches,
                 "device_flushes": self.device_flushes,

@@ -189,6 +189,14 @@ class VerificationService:
         # instances — one per simnet node — coexist in one process
         self.metrics = ServeMetrics(node=node)
         self.metrics.note_mesh(self._mesh_devices)
+        # commanded degradation-ladder rung (ISSUE 11 load shedding):
+        # 0 = normal (RLC combine first), 1 = per-group batched only,
+        # 2 = sequential oracle only. The fleet router moves it via
+        # set_ladder_rung when a burn window sheds this worker; the
+        # fault-driven degradations below are orthogonal (they fall
+        # DOWN from whatever rung is commanded).
+        self._ladder_rung = 0
+        self.metrics.note_ladder(0)
         self._closed = False
         # two-stage pipeline: prep(N+1) overlaps device(N) through a
         # one-slot hand-off queue
@@ -327,6 +335,27 @@ class VerificationService:
     def mesh_devices(self) -> int:
         """Devices the verify mesh spans (0 = single-device path)."""
         return self._mesh_devices
+
+    @property
+    def ladder_rung(self) -> int:
+        """The commanded degradation rung (0 RLC / 1 per-group / 2 oracle)."""
+        return self._ladder_rung
+
+    def set_ladder_rung(self, rung: int, reason: Optional[str] = None) -> None:
+        """Command the service onto a degradation-ladder rung — the load-
+        shedding control surface (ISSUE 11): the fleet router calls this
+        when SLO burn rates on the merged fleet histograms say this
+        worker must shed. Takes effect from the next flush; every
+        transition is journaled (``shed_rung``) so a shed decision and
+        the ladder move it caused reconstruct from the flight journal."""
+        rung = max(0, min(2, int(rung)))
+        with self._lock:
+            prev, self._ladder_rung = self._ladder_rung, rung
+        if prev != rung:
+            self.metrics.note_ladder(rung)
+            if self._flight is not None:
+                self._flight.note("serve", "shed_rung", rung_from=prev,
+                                  rung_to=rung, reason=reason)
 
     def _flush_mesh(self, n_items: int):
         """The mesh for an n_items flush — None when the batch is
@@ -503,6 +532,8 @@ class VerificationService:
         retry-then-oracle ladder, so an RLC-specific fault — e.g. a
         combine-program compile error — still degrades in two steps
         instead of straight to the sequential oracle)."""
+        if self._ladder_rung >= 1:
+            return None  # shed: the per-group (or oracle) path serves
         backend = self._resolve_backend()
         rlc_fn = getattr(backend, "batch_verify_rlc", None)
         if rlc_fn is None or not _rlc_enabled():
@@ -558,6 +589,11 @@ class VerificationService:
         return None
 
     def _verify_group(self, kind: str, pends: List[_Pending]) -> List[bool]:
+        if self._ladder_rung >= 2:
+            # commanded to the bottom rung: answer sequentially through
+            # the oracle — correct and load-free on the device plane
+            self.metrics.note_fallback(len(pends))
+            return [self._oracle_one(p) for p in pends]
         backend = self._resolve_backend()
         last_err = None
         for attempt in range(1 + self._backend_retries):

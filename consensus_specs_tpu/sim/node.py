@@ -38,7 +38,7 @@ class SimNode:
 
     def __init__(self, index: int, spec, anchor_state, anchor_block,
                  shared_state, *, honest: bool = True, sim_clock=None,
-                 flight_capacity: int = 4096):
+                 flight_capacity: int = 4096, backend=None):
         self.index = index
         self.name = f"n{index}"
         self.honest = honest
@@ -48,7 +48,11 @@ class SimNode:
         self.recorder = FlightRecorder(
             capacity=flight_capacity, node=self.name,
             clock=sim_clock if sim_clock is not None else (lambda: 0.0))
-        self.backend = VerdictBackend()
+        # default: the in-process crypto-free VerdictBackend; the fleet
+        # replay (sim/fleet_replay.py) injects an adapter that routes
+        # every check to REAL worker processes instead — same verdict
+        # rule, real process boundary
+        self.backend = backend if backend is not None else VerdictBackend()
         self.service = VerificationService(
             backend=self.backend, max_batch=8, max_wait_ms=1.0,
             node=self.name)

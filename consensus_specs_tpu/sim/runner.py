@@ -313,7 +313,8 @@ def run_scenario(scenario: Scenario, *, spec=None, anchor_state=None,
                  nodes: Optional[int] = None,
                  events_per_epoch: Optional[int] = None,
                  strict: bool = True, flight_dir: Optional[str] = None,
-                 query_rounds: int = 512) -> ScenarioReport:
+                 query_rounds: int = 512,
+                 backend_factory=None) -> ScenarioReport:
     """Run one scenario end to end and gate it. ``strict`` raises
     :class:`SimDivergence` on any convergence failure; bench mode passes
     ``strict=False`` and reads ``report.converged``/``report.error``.
@@ -349,9 +350,14 @@ def run_scenario(scenario: Scenario, *, spec=None, anchor_state=None,
     t_wall = time.perf_counter()
     try:
         for i in range(scenario.nodes):
+            # backend_factory (fleet replay): per-node verdict backends
+            # that cross a real process boundary instead of staying
+            # in-process — the scenario script and gate are unchanged
             sim_nodes.append(SimNode(
                 i, spec, anchor_state, anchor_block, anchor_state,
-                sim_clock=lambda: clock_box["now"]))
+                sim_clock=lambda: clock_box["now"],
+                backend=(backend_factory(f"n{i}")
+                         if backend_factory is not None else None)))
 
         # -- schedule ---------------------------------------------------------
         for t, origin, msg in script.block_publishes:

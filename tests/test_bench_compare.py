@@ -502,3 +502,88 @@ def test_finalexp_new_variant_cells_are_not_gated_until_seen(tmp_path, bc):
     _write_round(tmp_path, 2, _fx_parsed(
         8.0, {"host,1": (True, 16.5), "frobenius,8": (False, 0.0)}))
     assert bc.main(["--dir", str(tmp_path)]) == 0
+
+
+# -- fleet gate (ISSUE 11: `bench.py --mode serve-fleet` worker counts) -------
+
+
+def _fleet_parsed(value, counts, **extra):
+    """A `--mode serve-fleet` line: ``counts`` maps worker count (str) ->
+    (ok, sigs_per_sec)."""
+    fleet = {}
+    for name, (ok, sigs) in counts.items():
+        entry = {"ok": ok}
+        if ok:
+            entry["sigs_per_sec"] = sigs
+        else:
+            entry["error"] = "warm failed: worker w0 unreachable"
+        fleet[name] = entry
+    return _parsed(value, mode="serve-fleet", n=None, k=None, fleet=fleet,
+                   **extra)
+
+
+def test_fleet_newly_erroring_worker_count_fails(tmp_path, bc, capsys):
+    """A worker count that verified (verdicts + exact merged scrape) last
+    round and errors now fails outright — losing a working fleet size is
+    an availability regression, the mesh-gate mirror."""
+    _write_round(tmp_path, 1, _fleet_parsed(
+        45.0, {"1": (True, 35.0), "2": (True, 45.0)}))
+    _write_round(tmp_path, 2, _fleet_parsed(
+        44.0, {"1": (True, 34.0), "2": (False, 0.0)}))
+    assert bc.main(["--dir", str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "cpu:fleet:2" in out and "FLEET ERRORED" in out
+
+
+def test_fleet_sigs_per_sec_is_report_only(tmp_path, bc, capsys):
+    """Per-worker-count sigs/sec (and therefore the 2-worker speedup)
+    never fails on its own — shared-host process scaling jitters."""
+    _write_round(tmp_path, 1, _fleet_parsed(
+        45.0, {"1": (True, 35.0), "2": (True, 45.0)}))
+    _write_round(tmp_path, 2, _fleet_parsed(
+        45.0, {"1": (True, 30.0), "2": (True, 9.0)}))  # -80% per count
+    assert bc.main(["--dir", str(tmp_path)]) == 0
+    assert "cpu:fleet:2" in capsys.readouterr().out
+
+
+def test_fleet_still_erroring_is_not_a_new_failure(tmp_path, bc):
+    _write_round(tmp_path, 1, _fleet_parsed(
+        35.0, {"1": (True, 35.0), "4": (False, 0.0)}))
+    _write_round(tmp_path, 2, _fleet_parsed(
+        35.0, {"1": (True, 35.0), "4": (False, 0.0)}))
+    assert bc.main(["--dir", str(tmp_path)]) == 0
+
+
+def test_fleet_keys_join_without_common_throughput_keys(tmp_path, bc,
+                                                        capsys):
+    """Shared fleet keys are comparables in their own right (the SLO/sim/
+    mesh rule): disjoint throughput shapes must still gate ok -> error."""
+    _write_round(tmp_path, 1, _parsed(
+        1000.0, mode="head", n=None, k=None, blocks=1024,
+        fleet={"2": {"ok": True, "sigs_per_sec": 45.0}}))
+    _write_round(tmp_path, 2, _parsed(
+        900.0, mode="head", n=None, k=None, blocks=128,
+        fleet={"2": {"ok": False, "error": "worker died"}}))
+    assert bc.main(["--dir", str(tmp_path)]) == 1
+    assert "FLEET ERRORED" in capsys.readouterr().out
+
+
+def test_fleet_new_counts_are_not_gated_until_seen(tmp_path, bc):
+    """A worker count appearing for the first time has no baseline —
+    report-only this round, gated from the next."""
+    _write_round(tmp_path, 1, _fleet_parsed(35.0, {"1": (True, 35.0)}))
+    _write_round(tmp_path, 2, _fleet_parsed(
+        35.0, {"1": (True, 35.0), "8": (False, 0.0)}))
+    assert bc.main(["--dir", str(tmp_path)]) == 0
+
+
+def test_fleet_extract_shapes(bc):
+    doc = {"parsed": _fleet_parsed(
+        45.0, {"1": (True, 35.0), "2": (True, 45.0)})}
+    assert bc.extract_fleet(doc) == {
+        "cpu:fleet:1": {"ok": True, "sigs_per_sec": 35.0},
+        "cpu:fleet:2": {"ok": True, "sigs_per_sec": 45.0},
+    }
+    # error rounds and sections without rows extract nothing
+    assert bc.extract_fleet({"parsed": {"error": "boom"}}) == {}
+    assert bc.extract_fleet({"parsed": _parsed(300.0)}) == {}

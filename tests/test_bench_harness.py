@@ -102,6 +102,7 @@ def test_best_line_ignores_garbage(bench):
     assert best["value"] == 7.0 and err is None
 
 
+@pytest.mark.slow  # ~185 s: the worst tier-1 offender (ISSUE 11 audit)
 def test_child_runs_committee_then_epoch_then_probe(bench, monkeypatch, capsys):
     """The child must run the window-proven committee shape FIRST, then
     epoch, then the pallas A/B — one process, every stage surviving the

@@ -64,6 +64,7 @@ def test_complete_add_matches_oracle_cases():
         assert got == want, (a, b)
 
 
+@pytest.mark.slow  # ~44 s of sharded compiles (ISSUE 11 tier-1 audit)
 def test_mesh_aggregate_matches_oracle(mesh):
     # two shapes: sub-device-count (padding exercises infinity lanes) and a
     # multi-chunk fold; each k compiles its own scan length, so keep this
@@ -83,6 +84,7 @@ def test_mesh_aggregate_matches_oracle(mesh):
         assert got == (want_aff[0].n, want_aff[1].n), k
 
 
+@pytest.mark.slow  # ~23 s: 64-key device aggregation (ISSUE 11 audit)
 def test_aggregate_pubkeys_device_path_vs_oracle(mesh):
     privkeys = list(range(1, 65))
     pubkeys = [bls.SkToPk(sk) for sk in privkeys]

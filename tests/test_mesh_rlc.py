@@ -173,6 +173,7 @@ def _rand_f(rng: random.Random) -> O.Fq12:
     )
 
 
+@pytest.mark.slow  # ~36 s of butterfly compiles (ISSUE 11 tier-1 audit)
 def test_mesh_fq12_product_matches_oracle():
     """Local fold + ppermute butterfly == exact-int oracle product, at
     sub-device-count (identity padding) and multi-row widths."""

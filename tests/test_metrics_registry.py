@@ -121,7 +121,7 @@ def test_fleet_gauge_families_are_complete():
     # AND every registered label has an emission site — a rename in
     # either direction fails here instead of orphaning a scrape rule
     emitted = _emitted_labels()
-    for prefix in ("hist.", "device.", "flight.", "slo."):
+    for prefix in ("hist.", "device.", "flight.", "slo.", "fleet."):
         family_emitted = {l for l in emitted if l.startswith(prefix)}
         family_registered = {n for n in registry.GAUGES
                              if n.startswith(prefix)}

@@ -138,6 +138,73 @@ def test_merge_leaves_inputs_untouched():
     assert merged.count == 3  # detached
 
 
+# -- snapshot wire format (the fleet's cross-process boundary) ----------------
+
+
+def test_wire_round_trip_is_state_identical():
+    """serialize -> deserialize preserves the histogram's exact value
+    state (bucket dict, count, float sum bit-for-bit, extremes) — through
+    a REAL json encode/decode, since the worker protocol ships ndjson."""
+    import json
+
+    from consensus_specs_tpu.obs import snapshot as osnap
+
+    for seed, dist in ((3, "exp"), (4, "uniform")):
+        h = _feed(_stream(seed, 2500, dist))
+        wire = json.loads(json.dumps(osnap.hist_to_wire(h)))
+        back = osnap.hist_from_wire(wire)
+        assert back.state() == h.state()
+
+
+def test_wire_merge_is_bit_identical_to_in_process_merge():
+    """The ISSUE 11 acceptance property: serialize -> deserialize ->
+    merge must equal the in-process merge of the same histograms — the
+    split-feed == single-feed gate EXTENDED across the wire format. Every
+    field is compared exactly (== on floats: the merge folds sums in the
+    same order either way, and json round-trips float repr losslessly)."""
+    import json
+
+    from consensus_specs_tpu.obs import snapshot as osnap
+
+    values = _stream(17, 4000)
+    parts = [_feed(values[i::3]) for i in range(3)]
+    in_process = parts[0].merge(parts[1]).merge(parts[2])
+    wires = [json.loads(json.dumps(osnap.hist_to_wire(p))) for p in parts]
+    over_wire = osnap.merge_hist_wires(wires)
+    assert over_wire.state() == in_process.state()
+    # and both equal the single-feed histogram's buckets/counts
+    whole = _feed(values)
+    assert over_wire.state()["counts"] == whole.state()["counts"]
+    assert over_wire.count == whole.count
+    for q in (50, 95, 99):
+        assert over_wire.percentile(q) == whole.percentile(q)
+
+
+def test_wire_rejects_malformed_and_wrong_version():
+    from consensus_specs_tpu.obs import snapshot as osnap
+
+    with pytest.raises(osnap.WireError):
+        osnap.hist_from_wire({"counts": "nope"})
+    with pytest.raises(osnap.WireError):
+        osnap.check_version({"v": 99})
+    with pytest.raises(osnap.WireError):
+        osnap.check_version([])
+
+
+def test_process_snapshot_carries_hists_gauges_and_stats():
+    from consensus_specs_tpu.obs import snapshot as osnap
+
+    profiling.record_latency("serve.submit_to_result", 0.25)
+    profiling.set_gauge("serve.queue_depth", 3)
+    profiling.record("serve.batch_flush", 0.5)
+    snap = osnap.check_version(osnap.take_process_snapshot(worker="wX"))
+    assert snap["worker"] == "wX" and snap["pid"]
+    assert osnap.hist_from_wire(
+        snap["hists"]["serve.submit_to_result"]).count == 1
+    assert snap["gauges"]["serve.queue_depth"] == 3
+    assert snap["stats"]["serve.batch_flush"]["calls"] == 1
+
+
 # -- Prometheus exposition ----------------------------------------------------
 
 _BUCKET_RE = re.compile(

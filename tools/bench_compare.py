@@ -209,6 +209,32 @@ def extract_mesh(doc):
     return out
 
 
+def extract_fleet(doc):
+    """{``platform:fleet:<workers>``: {"ok", "sigs_per_sec"}} from one
+    round's ``fleet`` section (`bench.py --mode serve-fleet` per-worker-
+    count rows)."""
+    parsed = doc.get("parsed")
+    if not isinstance(parsed, dict) or "error" in parsed:
+        return {}
+    section = parsed.get("fleet")
+    if not isinstance(section, dict):
+        return {}
+    plat = _platform(parsed)
+    out = {}
+    for name, row in sorted(section.items()):
+        if not isinstance(row, dict) or "ok" not in row:
+            continue
+        try:
+            sigs = float(row.get("sigs_per_sec") or 0.0)
+        except (TypeError, ValueError):
+            sigs = 0.0
+        out[f"{plat}:fleet:{name}"] = {
+            "ok": bool(row.get("ok", False)),
+            "sigs_per_sec": sigs,
+        }
+    return out
+
+
 def extract_finalexp(doc):
     """{``platform:finalexp:<variant,rows>``: {"ok", "ms_per_row"}} from
     one round's ``finalexp`` section (`bench.py --mode finalexp` hard-part
@@ -291,6 +317,7 @@ def main(argv=None) -> int:
         new_sim = extract_sim(newest_doc)
         new_mesh = extract_mesh(newest_doc)
         new_fx = extract_finalexp(newest_doc)
+        new_fleet = extract_fleet(newest_doc)
     except (OSError, ValueError) as e:
         print(f"bench-compare: FAIL — {os.path.basename(newest)} unreadable: {e}")
         return 1
@@ -305,7 +332,7 @@ def main(argv=None) -> int:
         return 0
 
     prev_vals, prev_slo, prev_sim, prev_mesh = {}, {}, {}, {}
-    prev_fx, prev_path = {}, None
+    prev_fx, prev_fleet, prev_path = {}, {}, None
     for path in reversed(files[:-1]):
         try:
             doc = _load(path)
@@ -314,16 +341,19 @@ def main(argv=None) -> int:
             prev_sim = extract_sim(doc)
             prev_mesh = extract_mesh(doc)
             prev_fx = extract_finalexp(doc)
+            prev_fleet = extract_fleet(doc)
         except (OSError, ValueError):
             prev_vals, prev_slo, prev_sim = {}, {}, {}
-            prev_mesh, prev_fx = {}, {}
+            prev_mesh, prev_fx, prev_fleet = {}, {}, {}
         # an SLO-only or sim-only round (headline errored, objectives or
         # scenario matrix still recorded) is a usable baseline for its
         # state gate even with no throughput number
-        if prev_vals or prev_slo or prev_sim or prev_mesh or prev_fx:
+        if (prev_vals or prev_slo or prev_sim or prev_mesh or prev_fx
+                or prev_fleet):
             prev_path = path
             break
-    if not (prev_vals or prev_slo or prev_sim or prev_mesh or prev_fx):
+    if not (prev_vals or prev_slo or prev_sim or prev_mesh or prev_fx
+            or prev_fleet):
         print("bench-compare: SKIP — no earlier round recorded a usable value")
         return 0
 
@@ -332,8 +362,9 @@ def main(argv=None) -> int:
     sim_common = sorted(set(new_sim) & set(prev_sim))
     mesh_common = sorted(set(new_mesh) & set(prev_mesh))
     fx_common = sorted(set(new_fx) & set(prev_fx))
+    fleet_common = sorted(set(new_fleet) & set(prev_fleet))
     if (not common and not slo_common and not sim_common
-            and not mesh_common and not fx_common):
+            and not mesh_common and not fx_common and not fleet_common):
         # SLO keys count as comparables too: two rounds that share no
         # throughput shape but both declare serve_p99 must still gate the
         # objective state, not skip past it
@@ -427,6 +458,31 @@ def main(argv=None) -> int:
         if broke:
             failures.append(key)
 
+    # fleet state gate: a worker count that verified (correct verdicts +
+    # exact merged scrape) last round and errors now fails outright —
+    # "FLEET ERRORED", the mesh-gate mirror: losing a working fleet size
+    # is an availability regression; per-count sigs/sec and the 2-worker
+    # speedup are report-only (process scaling on the shared CI host
+    # jitters like every other CPU number)
+    for key in fleet_common:
+        old, new = prev_fleet[key], new_fleet[key]
+        broke = old["ok"] and not new["ok"]
+        status = "FLEET ERRORED" if broke else (
+            "ok" if new["ok"] else "still erroring")
+        print(
+            f"  {key}: {old['sigs_per_sec']:.2f} -> "
+            f"{new['sigs_per_sec']:.2f} sigs/sec (ok: {old['ok']} -> "
+            f"{new['ok']}){'  ' + status if broke else ''}"
+        )
+        rows.append((key, f"{old['sigs_per_sec']:.2f}",
+                     f"{new['sigs_per_sec']:.2f}",
+                     (new["sigs_per_sec"] - old["sigs_per_sec"])
+                     / old["sigs_per_sec"]
+                     if old["sigs_per_sec"] else None,
+                     status))
+        if broke:
+            failures.append(key)
+
     # finalexp state gate: a hard-part variant cell that worked last round
     # and errors (or returns wrong verdicts) now fails outright — losing a
     # finalization variant is a correctness/availability regression; the
@@ -469,6 +525,8 @@ def main(argv=None) -> int:
            if mesh_common else "")
         + (f", {len(fx_common)} finalexp cell(s) gated"
            if fx_common else "")
+        + (f", {len(fleet_common)} fleet worker count(s) gated"
+           if fleet_common else "")
     )
     return 0
 
