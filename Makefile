@@ -20,7 +20,8 @@ CHEAP_GENERATORS = shuffling bls ssz_generic merkle
         detect_generator_incomplete check_vectors bench serve-bench codec-bench multichip \
         clean_vectors generate_random_tests bench-compare check serve-trace head-bench docs \
         sim-bench sim-smoke serve-bench-mesh mesh-smoke clean rlc-bench \
-        finalexp-bench finalexp-smoke native sweep serve-fleet-bench fleet-smoke
+        finalexp-bench finalexp-smoke native sweep serve-fleet-bench fleet-smoke \
+        latency-bench latency-smoke
 
 # fast default: BLS stubbed except @always_bls, 4-way process-parallel
 # (reference `make test` = pytest -n 4, reference Makefile:100)
@@ -211,6 +212,30 @@ sim-bench:
 sim-smoke:
 	JAX_PLATFORMS=cpu python -m consensus_specs_tpu.sim.smoke
 
+# end-to-end gossip→head latency matrix (ISSUE 12): latency_skew and
+# lossy_links simnet scenarios, each run under the classic
+# size-or-deadline flush, the slot-budget deadline scheduler
+# (CONSENSUS_SPECS_TPU_SLOT_MS semantics, shared SlotClock), and
+# deadline+speculative head application — the JSON line carries
+# gossip_to_head p50/p99 per scenario × policy, the deadline-flush win
+# (baseline p99 / deadline p99), rollback counts from the invalid-sig
+# traffic, and an `slo` section evaluating the declared
+# gossip_to_head_p99 objective over the exact merge of the deadline-mode
+# histograms. tools/bench_compare.py gates the per-scenario ok-state
+# ("LATENCY SLO VIOLATED"); the p99 milliseconds are report-only.
+# LATENCY_* env resizes (scenarios, wait, slot, nodes, events).
+latency-bench:
+	JAX_PLATFORMS=cpu CONSENSUS_SPECS_TPU_SIM_FLIGHT_DIR=sim_flight python bench.py --mode latency
+
+# latency-plane CI canary (mirror of sim/mesh/finalexp/fleet smokes): one
+# short latency_skew scenario with deadline flushing + speculative head
+# application through the STRICT convergence gate, then the
+# gossip_to_head_p99 presence assert (the end-to-end histogram must be
+# non-empty and the objective met); per-node flight journals land in
+# sim_flight/ — uploaded as CI artifacts on failure
+latency-smoke:
+	JAX_PLATFORMS=cpu python -m consensus_specs_tpu.sim.latency_smoke
+
 # final-exp microbenchmark: per-item easy+hard finalization vs the RLC
 # combine (one final exponentiation per batch) on identical Miller
 # outputs, items/sec across N in {4,16,64,256}; the JSON line's
@@ -254,7 +279,8 @@ clean:
 	rm -rf serve_trace.json serve_flight.jsonl flight_dump.jsonl \
 		mesh_flight.jsonl finalexp_flight.jsonl sim_flight/ \
 		fleet_flight.jsonl serve_flight.*.jsonl flight_dump.*.jsonl \
-		mesh_flight.*.jsonl finalexp_flight.*.jsonl fleet_flight.*.jsonl
+		mesh_flight.*.jsonl finalexp_flight.*.jsonl fleet_flight.*.jsonl \
+		*-pid[0-9]*.jsonl
 
 # build the native kernels (csrc/): batched-SHA256 merkleization and the
 # VM assembler's scheduling+allocation kernel (ops/vm.py loads it via

@@ -551,6 +551,25 @@ def main():
         _emit_result(run_sim_bench())
         return
 
+    if _cli_mode() == "latency":
+        # end-to-end gossip→head latency matrix (ISSUE 12): latency_skew
+        # and lossy_links simnet scenarios, each under the classic
+        # size-or-deadline flush, the slot-budget deadline scheduler, and
+        # deadline+speculative head application — gossip_to_head_p99 per
+        # scenario with the deadline-flush win quantified. CPU-forced —
+        # the thing measured is flush scheduling and fork-choice latency,
+        # not device math. The `latency` section is state-gated round
+        # over round by tools/bench_compare.py ("LATENCY SLO VIOLATED").
+        from consensus_specs_tpu.utils.jax_env import force_cpu
+
+        force_cpu()
+        from consensus_specs_tpu.bench.latency_pipeline import (
+            run_latency_bench,
+        )
+
+        _emit_result(run_latency_bench())
+        return
+
     if _cli_mode() == "finalexp":
         # hard-part microbench (ISSUE 10): host-oracle HHT vs the VM
         # hard-part variants (bit_serial, windowed, frobenius) at

@@ -38,7 +38,10 @@ Gossip reality is handled the way real clients do:
   or malformed committees are dropped and counted;
 - everything observable exports through ``chain.*`` metrics
   (obs/registry.py) and per-batch spans (validate / sig_wait / apply /
-  sweep) on the request tracer when tracing is enabled.
+  sweep / head) on the request tracer when tracing is enabled; gossip
+  items arriving with a birth record (``obs/latency.py``) additionally
+  land their end-to-end gossip→head latency in the
+  ``latency.gossip_to_head`` histogram at the head stage.
 
 Threading contract: one mutator at a time (a gossip loop), matching the
 spec Store's own single-writer shape. Reads (``get_head``,
@@ -49,11 +52,17 @@ import time
 from collections import deque
 from typing import List, Optional, Tuple
 
-from ..obs import flight, tracing
+from ..obs import flight, latency, tracing
 from .metrics import ChainMetrics
 from .proto_array import ProtoForkChoice
 
 DIFF_ENV = "CONSENSUS_SPECS_TPU_CHAIN_DIFF"
+# speculative head application (ISSUE 12): apply a batch's latest-message
+# updates to the proto-array BEFORE the signature verdicts return, and
+# roll back (exact weight-delta reversal) if any verdict fails — the head
+# answers with the new votes a whole sig_wait earlier, and the RLC
+# bisection already localizes any liar the rollback then unwinds
+SPECULATE_ENV = "CONSENSUS_SPECS_TPU_SPECULATE"
 
 # attestation routing verdicts (metrics buckets + deferral control)
 OK, DEFER, DROP = "ok", "defer", "drop"
@@ -76,12 +85,15 @@ class _Verdict:
 
 
 class _Prepared:
-    __slots__ = ("attestation", "indices", "future")
+    __slots__ = ("attestation", "indices", "future", "birth")
 
-    def __init__(self, attestation, indices, future):
+    def __init__(self, attestation, indices, future, birth=None):
         self.attestation = attestation
         self.indices = indices
         self.future = future
+        # obs.latency.Birth from gossip ingress (or None): the end-to-end
+        # gossip→head timeline's origin and Chrome flow id
+        self.birth = birth
 
 
 class HeadService:
@@ -98,7 +110,7 @@ class HeadService:
                  differential: Optional[bool] = None,
                  max_deferred: int = 4096, defer_retries: int = 8,
                  verify_timeout: float = 120.0, node: Optional[str] = None,
-                 recorder=None):
+                 recorder=None, speculative: Optional[bool] = None):
         self.spec = spec
         self.node = node
         self.store = spec.get_forkchoice_store(anchor_state, anchor_block)
@@ -117,14 +129,23 @@ class HeadService:
         if differential is None:
             differential = os.environ.get(DIFF_ENV, "0") not in ("", "0")
         self._differential = differential
+        if speculative is None:
+            speculative = os.environ.get(SPECULATE_ENV, "0") not in ("", "0")
+        # speculation needs an async verdict source to hide latency
+        # behind; with the inline _Verdict path the verdicts are already
+        # in hand before any apply could speculate
+        self._speculative = bool(speculative) and service is not None
         self._max_deferred = max_deferred
         self._defer_retries = defer_retries
         self._verify_timeout = verify_timeout
-        # (attestation, attempts, missing) — `missing` is the block root
-        # the entry is waiting on, or None for time-gated defers (future
-        # slot/epoch). Attempts only tick when the entry's own trigger
-        # fired and it STILL re-deferred, never on unrelated arrivals.
-        self._deferred: "deque[Tuple[object, int, object]]" = deque()
+        # (attestation, attempts, missing, birth) — `missing` is the
+        # block root the entry is waiting on, or None for time-gated
+        # defers (future slot/epoch); `birth` is the item's gossip-ingress
+        # record (obs/latency.py) so a deferred-then-resolved attestation
+        # still reports its TRUE gossip→head latency, deferral included.
+        # Attempts only tick when the entry's own trigger fired and it
+        # STILL re-deferred, never on unrelated arrivals.
+        self._deferred: "deque[Tuple[object, int, object, object]]" = deque()
 
         self.fc = ProtoForkChoice()
         anchor_root = bytes(spec.hash_tree_root(anchor_block))
@@ -179,8 +200,8 @@ class HeadService:
             # TIME-gated entries are charged a retry attempt — a
             # block-gated entry's trigger is its missing root, so ticks
             # re-route it uncharged (stale-epoch eviction still applies)
-            retry = [(att, attempts, missing is None)
-                     for att, attempts, missing in self._deferred]
+            retry = [(att, attempts, missing is None, birth)
+                     for att, attempts, missing, birth in self._deferred]
             self._deferred.clear()
         if retry or checkpoint_moved:
             self._ingest_batch([], retries=retry)
@@ -195,11 +216,11 @@ class HeadService:
         if not self._deferred:
             return []
         resolved, keep = [], deque()
-        for att, attempts, missing in self._deferred:
+        for att, attempts, missing, birth in self._deferred:
             if missing is not None and missing in self.store.blocks:
-                resolved.append((att, attempts, True))
+                resolved.append((att, attempts, True, birth))
             else:
-                keep.append((att, attempts, missing))
+                keep.append((att, attempts, missing, birth))
         self._deferred = keep
         return resolved
 
@@ -228,13 +249,21 @@ class HeadService:
         batch = list(block.body.attestations) if process_attestations else []
         self._ingest_batch(batch, retries=self._take_resolved_deferred())
 
-    def on_attestation(self, attestation) -> dict:
-        return self.on_attestations([attestation])
+    def on_attestation(self, attestation, birth=None) -> dict:
+        return self.on_attestations([attestation],
+                                    births=None if birth is None
+                                    else [birth])
 
-    def on_attestations(self, attestations) -> dict:
+    def on_attestations(self, attestations, births=None) -> dict:
         """One gossip micro-batch: validate → verify (batched through the
-        service) → apply → one sweep. Returns the routing summary."""
-        return self._ingest_batch(list(attestations))
+        service) → apply → one sweep. Returns the routing summary.
+
+        ``births`` (optional, aligned with ``attestations``; entries may
+        be None) carries each item's gossip-ingress record
+        (``obs/latency.birth()``): the end-to-end gossip→head latency is
+        then recorded per item at the head update that reflects its vote,
+        and the serve/chain span trees link by Chrome flow id."""
+        return self._ingest_batch(list(attestations), births=births)
 
     # -- pipeline ------------------------------------------------------------
 
@@ -270,7 +299,7 @@ class HeadService:
             return DEFER, None
         return OK, None
 
-    def _prepare(self, attestation) -> Optional[_Prepared]:
+    def _prepare(self, attestation, birth=None) -> Optional[_Prepared]:
         """Index the attestation against its target checkpoint state and
         submit the signature check. Returns None for structurally invalid
         committees (the spec's non-crypto ``is_valid_indexed_attestation``
@@ -292,19 +321,57 @@ class HeadService:
         signing_root = bytes(spec.compute_signing_root(indexed.data, domain))
         signature = bytes(attestation.signature)
         if self._service is not None:
-            future = self._service.submit("fast_aggregate", pubkeys,
-                                          signing_root, signature)
+            if birth is not None:
+                # thread the ingress record through the serve plane: the
+                # request trace gains the ingress span and the Chrome
+                # flow id that links it to this chain batch
+                future = self._service.submit(
+                    "fast_aggregate", pubkeys, signing_root, signature,
+                    birth_s=birth.t, flow_id=birth.trace_id)
+            else:
+                future = self._service.submit("fast_aggregate", pubkeys,
+                                              signing_root, signature)
         else:
             future = _Verdict(bool(spec.bls.FastAggregateVerify(
                 pubkeys, signing_root, signature)))
-        return _Prepared(attestation, indices, future)
+        return _Prepared(attestation, indices, future, birth=birth)
 
-    def _ingest_batch(self, attestations: List, retries: List = ()) -> dict:
+    def _speculate_item(self, item: _Prepared) -> Tuple[list, int]:
+        """Apply one prepared item's latest messages to the PROTO ARRAY
+        only, capturing undo tokens (the spec store — the oracle — is
+        never speculated on). Returns ``(tokens, moved)``."""
+        att = item.attestation
+        target_epoch = int(att.data.target.epoch)
+        root = bytes(att.data.beacon_block_root)
+        tokens, moved = [], 0
+        for i in item.indices:
+            applied, token = self.fc.speculate_latest_message(
+                int(i), root, target_epoch)
+            if applied:
+                moved += 1
+                tokens.append(token)
+        return tokens, moved
+
+    def _ingest_batch(self, attestations: List, retries: List = (),
+                      births: Optional[List] = None) -> dict:
         """The per-batch pipeline shared by every ingress path. ``retries``
-        carries ``(attestation, attempts, charge)`` deferral entries
-        riding along — ``charge`` says whether this retry counts against
-        the entry's budget (its own trigger fired) or is incidental (a
-        tick re-examining a block-gated entry for staleness)."""
+        carries ``(attestation, attempts, charge, birth)`` deferral
+        entries riding along — ``charge`` says whether this retry counts
+        against the entry's budget (its own trigger fired) or is
+        incidental (a tick re-examining a block-gated entry for
+        staleness). ``births`` aligns with ``attestations`` (entries may
+        be None): the gossip-ingress records the end-to-end latency plane
+        stitches from.
+
+        With speculation armed (``CONSENSUS_SPECS_TPU_SPECULATE`` /
+        ``speculative=``), the batch's latest messages land on the
+        proto-array BEFORE the signature verdicts return — ``get_head``
+        answers with the new votes a whole sig_wait earlier. Any failed
+        verdict rolls the WHOLE speculative batch back (LIFO weight-delta
+        reversal, so intra-batch displacement chains unwind exactly) and
+        the verified members re-apply on the normal path — the post-batch
+        state is bit-identical to never having speculated, which is what
+        the differential gates assert."""
         t0 = time.perf_counter()
         trace = None
         if self._tracer is not None:
@@ -314,10 +381,10 @@ class HeadService:
                    "resolved": 0}
         prepared: List[Tuple[_Prepared, bool]] = []  # (item, was_deferred)
 
-        def route(att, attempts, was_deferred, charge=True):
+        def route(att, attempts, was_deferred, charge=True, birth=None):
             verdict, missing = self._classify(att)
             if verdict == OK:
-                item = self._prepare(att)
+                item = self._prepare(att, birth)
                 if item is None:
                     summary["dropped"] += 1
                     self.metrics.note_dropped()
@@ -326,7 +393,7 @@ class HeadService:
             elif verdict == DEFER and attempts < self._defer_retries \
                     and len(self._deferred) < self._max_deferred:
                 attempts = attempts + 1 if charge else attempts
-                self._deferred.append((att, attempts, missing))
+                self._deferred.append((att, attempts, missing, birth))
                 summary["deferred"] += 1
                 self.metrics.note_deferred(len(self._deferred))
                 if self._flight is not None:
@@ -342,15 +409,46 @@ class HeadService:
                                       slot=int(att.data.slot),
                                       verdict=verdict)
 
-        for att in attestations:
-            route(att, 0, was_deferred=False)
-        for att, attempts, charge in retries:
-            route(att, attempts, was_deferred=True, charge=charge)
+        if births is None:
+            births = [None] * len(attestations)
+        elif len(births) != len(attestations):
+            # zip would silently drop the tail — a misaligned caller must
+            # fail loudly, not diverge from peers that processed the rest
+            raise ValueError(
+                f"births misaligned: {len(births)} births for "
+                f"{len(attestations)} attestations")
+        for att, birth in zip(attestations, births):
+            route(att, 0, was_deferred=False, birth=birth)
+        for att, attempts, charge, birth in retries:
+            route(att, attempts, was_deferred=True, charge=charge,
+                  birth=birth)
         t1 = time.perf_counter()
+
+        # -- speculative apply (before any verdict is in hand) ---------------
+        speculated = False
+        spec_tokens: list = []
+        spec_moved: dict = {}
+        t_spec_head = None
+        if self._speculative and prepared:
+            for item, _was_deferred in prepared:
+                tokens, moved = self._speculate_item(item)
+                spec_tokens.extend(tokens)
+                spec_moved[id(item)] = moved
+            self.fc.apply()
+            self._update_head()
+            t_spec_head = time.perf_counter()
+            speculated = True
+            self.metrics.note_speculative(len(prepared))
+            if self._flight is not None:
+                self._flight.note("chain", "speculative_apply",
+                                  items=len(prepared),
+                                  votes=len(spec_tokens),
+                                  head_slot=self._head_slot)
 
         # the whole batch's signature checks are in the service's
         # micro-batching pipeline now; collect verdicts
         verified: List[Tuple[_Prepared, bool]] = []
+        failed = 0
         for item, was_deferred in prepared:
             try:
                 ok = bool(item.future.result(timeout=self._verify_timeout))
@@ -359,6 +457,7 @@ class HeadService:
             if ok:
                 verified.append((item, was_deferred))
             else:
+                failed += 1
                 summary["dropped"] += 1
                 self.metrics.note_dropped()
                 if self._flight is not None:
@@ -368,8 +467,29 @@ class HeadService:
                         verdict="bad_signature")
         t2 = time.perf_counter()
 
+        if speculated and failed:
+            # a liar in the batch: unwind EVERYTHING this batch put on
+            # the array (LIFO, exact), then let the verified members
+            # re-apply below exactly as an unspeculated batch would —
+            # never surgically keep speculative state around a failure
+            reverted = self.fc.rollback_latest_messages(spec_tokens)
+            self.metrics.note_rollback()
+            if self._flight is not None:
+                self._flight.note("chain", "rollback", failed=failed,
+                                  reverted=reverted, items=len(prepared))
+            speculated = False
+            t_spec_head = None
+
         for item, was_deferred in verified:
-            applied = self._apply_latest_messages(item)
+            if speculated:
+                # proto array already holds this item's votes; mirror
+                # them into the spec store (the oracle is only ever fed
+                # VERIFIED votes, speculation or not)
+                self.spec.update_latest_messages(
+                    self.store, item.indices, item.attestation)
+                applied = spec_moved.get(id(item), 0)
+            else:
+                applied = self._apply_latest_messages(item)
             if applied:
                 summary["applied"] += applied
                 self.metrics.note_applied(applied)
@@ -384,14 +504,34 @@ class HeadService:
         self.fc.apply()
         self._update_head()
         t4 = time.perf_counter()
-        self.metrics.note_batch(t4 - t0)
+
+        # -- head stage: the end-to-end timeline terminates here --------------
+        # an item's gossip→head latency ends at the head update that
+        # first reflected its vote: the SPECULATIVE update when the whole
+        # batch survived, the post-verdict sweep otherwise
+        head_ts = t_spec_head if t_spec_head is not None else t4
+        flows = []
+        for item, _was_deferred in verified:
+            if item.birth is not None:
+                latency.note_gossip_to_head(max(0.0, head_ts - item.birth.t))
+                flows.append(item.birth.trace_id)
+        t5 = time.perf_counter()
+
+        self.metrics.note_batch(t5 - t0)
         self.metrics.export_gauges(tracked_blocks=self.fc.block_count)
+        latency.note_stage("validate", t1 - t0)
+        latency.note_stage("sig_wait", t2 - t1)
+        latency.note_stage("apply", t3 - t2)
+        latency.note_stage("sweep", t4 - t3)
+        latency.note_stage("head", t5 - t4)
         if trace is not None:
             self._tracer.span(trace, "validate", t0, t1)
             self._tracer.span(trace, "sig_wait", t1, t2)
             self._tracer.span(trace, "apply", t2, t3)
             self._tracer.span(trace, "sweep", t3, t4)
-            self._tracer.finish(trace, True, t4)
+            self._tracer.span(trace, "head", t4, t5)
+            trace.flows = tuple(flows)
+            self._tracer.finish(trace, True, t5)
         if self._differential:
             self._assert_spec_head()
         return summary

@@ -34,6 +34,8 @@ GAUGE_LABELS = (
     "chain.deferred_attestations",
     "chain.dropped_attestations",
     "chain.deferred_pending",
+    "chain.speculative_applied",
+    "chain.rollbacks",
 )
 
 
@@ -60,6 +62,11 @@ class ChainMetrics:
         self.head_slot = 0
         self.deferred_pending = 0
         self.pruned_nodes = 0
+        # speculative head application (ISSUE 12): attestations applied
+        # to the proto-array before their verdicts returned, and batches
+        # that had to be reverted (weight-delta reversal) on a failure
+        self.speculative_applied = 0
+        self.rollbacks = 0
 
     # -- recording hooks (head_service.py) ----------------------------------
 
@@ -93,6 +100,14 @@ class ChainMetrics:
         with self._lock:
             self.pruned_nodes += n
 
+    def note_speculative(self, n: int = 1) -> None:
+        with self._lock:
+            self.speculative_applied += n
+
+    def note_rollback(self) -> None:
+        with self._lock:
+            self.rollbacks += 1
+
     def note_batch(self, seconds: float) -> None:
         with self._lock:
             self.batches += 1
@@ -123,6 +138,8 @@ class ChainMetrics:
                 self.deferred,
                 self.dropped,
                 self.deferred_pending,
+                self.speculative_applied,
+                self.rollbacks,
             )
         for label, value in zip(self._gauge_labels, values):
             profiling.set_gauge(label, value)
@@ -144,5 +161,7 @@ class ChainMetrics:
                 "head_slot": self.head_slot,
                 "deferred_pending": self.deferred_pending,
                 "pruned_nodes": self.pruned_nodes,
+                "speculative_applied": self.speculative_applied,
+                "rollbacks": self.rollbacks,
                 "apply_latency": lat,
             }

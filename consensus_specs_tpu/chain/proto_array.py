@@ -268,16 +268,57 @@ class ProtoForkChoice:
                           epoch: int) -> bool:
         """The spec's latest-message rule: only a strictly newer target
         epoch displaces an existing vote. Returns whether it applied."""
+        applied, _token = self.speculate_latest_message(validator, root,
+                                                        epoch)
+        return applied
+
+    def speculate_latest_message(self, validator: int, root: bytes,
+                                 epoch: int):
+        """``on_latest_message`` that also returns an undo token — the
+        speculative-apply primitive (ISSUE 12): HeadService applies a
+        batch's votes BEFORE the signature verdicts return and, on any
+        failure, hands the batch's tokens back to
+        :meth:`rollback_latest_messages`. The token captures the
+        displaced vote (or None), which with the current balance set is
+        everything reversal needs. Returns ``(applied, token)``; a vote
+        the latest-message rule rejects applies nothing and yields no
+        token."""
         prev = self._votes.get(validator)
         if prev is not None and epoch <= prev[1]:
-            return False
+            return False, None
         balance = self._balances.get(validator, 0)
         if prev is not None and balance:
             self.array.add_delta(prev[0], -balance)
         if balance:
             self.array.add_delta(root, balance)
         self._votes[validator] = (root, epoch)
-        return True
+        return True, (validator, prev)
+
+    def rollback_latest_messages(self, tokens) -> int:
+        """Reverse a speculative batch: LIFO over ``tokens`` (the order
+        they were produced in), each reversal queueing the exact opposite
+        weight deltas and restoring the displaced vote — so a validator
+        speculated twice in one batch unwinds through its intermediate
+        state back to the pre-batch table, bit-identically. Only valid
+        while the balance set is unchanged since the speculation (the
+        HeadService batch pipeline guarantees it: checkpoint refreshes
+        happen between batches, never inside one). Returns the number of
+        reversed applications."""
+        reversed_n = 0
+        for token in reversed([t for t in tokens if t is not None]):
+            validator, prev = token
+            cur = self._votes.get(validator)
+            balance = self._balances.get(validator, 0)
+            if balance and cur is not None:
+                self.array.add_delta(cur[0], -balance)
+            if prev is None:
+                self._votes.pop(validator, None)
+            else:
+                if balance:
+                    self.array.add_delta(prev[0], balance)
+                self._votes[validator] = prev
+            reversed_n += 1
+        return reversed_n
 
     def update_checkpoints(self, justified: Checkpoint, finalized: Checkpoint,
                            balances: Dict[int, int]) -> int:

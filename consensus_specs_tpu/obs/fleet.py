@@ -153,7 +153,8 @@ class FleetAggregator:
         return out
 
     def merged_view(self, local_stats: Optional[Dict] = None,
-                    local_gauges: Optional[Dict] = None
+                    local_gauges: Optional[Dict] = None,
+                    local_hists: Optional[Dict] = None
                     ) -> Tuple[Dict, Dict, Dict]:
         """The (stats, gauges, hists) triple the Prometheus renderer
         consumes. ``local_*`` overlay the aggregator process's own state
@@ -162,9 +163,16 @@ class FleetAggregator:
         router-computed), unknown keys add, and any other collision
         keeps the WORKER sum (e.g. the router dumping its own flight
         journal sets a local ``flight.events`` that must not clobber the
-        fleet-summed counter — the merged scrape stays the exact merge)."""
+        fleet-summed counter — the merged scrape stays the exact merge).
+        ``local_hists`` (the router process's own latency histograms —
+        e.g. the chain plane's end-to-end ``latency.gossip_to_head``
+        when a HeadService runs router-side, ISSUE 12) MERGE exactly
+        with the worker families: histogram observations are disjoint by
+        construction, so a label collision sums bucket mass like any
+        other fleet member's."""
         stats = self.merged_stats()
         gauges = self.merged_gauges()
+        hists = self.merged_hists()
         if local_stats:
             for label, entry in local_stats.items():
                 stats[label] = (snapshot.merge_stat_entries(
@@ -173,13 +181,19 @@ class FleetAggregator:
             for label, value in local_gauges.items():
                 if label.startswith(("fleet.", "slo.")) or label not in gauges:
                     gauges[label] = value
-        return stats, gauges, self.merged_hists()
+        if local_hists:
+            for label, h in local_hists.items():
+                hists[label] = (hists[label].merge(h) if label in hists
+                                else h)
+        return stats, gauges, hists
 
     def render_metrics(self, local_stats: Optional[Dict] = None,
-                       local_gauges: Optional[Dict] = None) -> str:
+                       local_gauges: Optional[Dict] = None,
+                       local_hists: Optional[Dict] = None) -> str:
         """The fleet-wide ``/metrics`` body: the standard Prometheus
         renderer over the merged triple."""
-        stats, gauges, hists = self.merged_view(local_stats, local_gauges)
+        stats, gauges, hists = self.merged_view(local_stats, local_gauges,
+                                                local_hists)
         return registry.render_prometheus(stats=stats, gauges=gauges,
                                           hists=hists)
 

@@ -34,8 +34,15 @@ SPAN_STAGES: Dict[str, tuple] = {
     # the serve pipeline's five per-request stages (`combine` only appears
     # on RLC-routed flushes)
     "serve": ("queue_wait", "prep", "device", "combine", "finalize"),
-    # the chain plane's per-gossip-batch stages (PR 5)
-    "chain": ("validate", "sig_wait", "apply", "sweep"),
+    # the chain plane's per-gossip-batch stages (PR 5; `head` is the
+    # ISSUE 12 tail — the sweep's head refresh + gossip→head latency
+    # recording, the stage the end-to-end timeline terminates in)
+    "chain": ("validate", "sig_wait", "apply", "sweep", "head"),
+    # the gossip→head stitching plane (ISSUE 12): `ingress` spans a
+    # gossip item's birth (sim fabric delivery / serve submit arrival)
+    # to its acceptance into the serve queue — stamped on request traces
+    # whose submit carried a birth timestamp
+    "latency": ("ingress",),
 }
 
 GAUGES: Dict[str, str] = {
@@ -53,6 +60,14 @@ GAUGES: Dict[str, str] = {
                          "service (0 = RLC combine, 1 = per-group batched, "
                          "2 = sequential oracle; the fleet router's shed "
                          "decisions move it)",
+    "serve.deadline_flushes": "flushes fired early by the slot-budget "
+                              "rule (remaining slot time minus the "
+                              "observed downstream p99 would have been "
+                              "blown by waiting for size-or-deadline; "
+                              "CONSENSUS_SPECS_TPU_SLOT_MS arms it)",
+    "serve.deadline_budget_ms": "slot budget remaining at the most "
+                                "recent deadline-driven flush (ms, after "
+                                "subtracting the downstream p99)",
     "fleet.workers": "live worker processes behind the fleet router "
                      "(drained workers leave the ring and this count)",
     "fleet.snapshots": "per-worker observability snapshots the fleet "
@@ -92,6 +107,14 @@ GAUGES: Dict[str, str] = {
     "chain.dropped_attestations": "attestations rejected: bad signature, "
                                   "non-viable vote, or retries exhausted",
     "chain.deferred_pending": "deferral buffer depth right now",
+    "chain.speculative_applied": "attestations applied to the proto-array "
+                                 "BEFORE their signature verdicts "
+                                 "returned (CONSENSUS_SPECS_TPU_SPECULATE; "
+                                 "rolled back on failure)",
+    "chain.rollbacks": "speculative batches reverted because at least one "
+                       "member's signature verdict came back False "
+                       "(weight-delta reversal; the verified members "
+                       "re-apply)",
     "vm.analysis_programs": "VM programs analyzed by the last vmlint run "
                             "in this process",
     "vm.analysis_errors": "bound-soundness errors vmlint found (nonzero "
@@ -149,6 +172,12 @@ LATENCIES: Dict[str, str] = {
                               "histogram)",
     "chain.apply_batch": "per-gossip-batch apply latency: validate + "
                          "signature wait + latest-message apply + sweep",
+    "latency.gossip_to_head": "END-TO-END gossip→head latency: an item's "
+                              "ingress birth to the head update that "
+                              "reflects its vote (the speculative update "
+                              "when speculation is on) — the "
+                              "gossip_to_head_p99 SLO's histogram, "
+                              "fleet-mergeable like every latency family",
 }
 
 # dynamic label families: labels built at runtime with a shape/program
@@ -160,6 +189,12 @@ DYNAMIC_PREFIXES: Dict[str, tuple] = {
     "device[": ("device_busy_frac", "per-device occupancy (busy seconds / "
                                     "elapsed), labelled device[<index>] "
                                     "(device[host] is the prep lane)"),
+    "latency[": ("latency_stage", "per-stage gossip→head latency "
+                                  "histograms, labelled latency[<stage>] "
+                                  "over the fixed obs/latency.py stage "
+                                  "set (ingress/queue_wait/prep/device/"
+                                  "combine/finalize/validate/sig_wait/"
+                                  "apply/sweep/head)"),
     # node-labelled instance families (simnet: N HeadService /
     # VerificationService instances in ONE process — the bare chain.* /
     # serve.* gauges would collide, so each instance exports under

@@ -24,8 +24,8 @@ objective fails CI like a throughput regression does.
 
 Objectives are env-tunable without code: ``CONSENSUS_SPECS_TPU_SLO`` is a
 comma list of ``key=value_ms`` overrides (``serve_p99_ms``,
-``chain_p99_ms``). Defaults are CPU-container-sized; an accelerator
-deployment tightens them by env.
+``chain_p99_ms``, ``gossip_to_head_p99_ms``). Defaults are
+CPU-container-sized; an accelerator deployment tightens them by env.
 """
 import os
 import threading
@@ -46,6 +46,14 @@ SLO_ENV = "CONSENSUS_SPECS_TPU_SLO"
 _DEFAULTS: Tuple[Tuple[str, str, float, float], ...] = (
     ("serve_p99", "serve.submit_to_result", 99.0, 30_000.0),
     ("chain_p99", "chain.apply_batch", 99.0, 2_000.0),
+    # the per-slot end-to-end objective (ISSUE 12): 99% of gossip items
+    # must move the head within one sub-second budget. The crypto-free
+    # simnet/latency-bench paths that feed latency.gossip_to_head land in
+    # the low tens of ms on this container; 1000 ms is the "sub-second
+    # finality" claim itself, with rollback/deferral churn headroom — a
+    # violation under the latency_skew / lossy_links adversarial runs
+    # means a regression, not noise (gated by tools/bench_compare.py).
+    ("gossip_to_head_p99", "latency.gossip_to_head", 99.0, 1_000.0),
 )
 
 # fast + slow burn windows (seconds): the classic multi-window pair,

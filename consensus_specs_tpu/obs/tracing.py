@@ -46,6 +46,10 @@ TRACE_ENV = "CONSENSUS_SPECS_TPU_TRACE"
 # signature verdicts, latest-message application, the reverse sweep)
 STAGES = _registry.SPAN_STAGES["serve"]
 CHAIN_STAGES = _registry.SPAN_STAGES["chain"]
+# the gossip→head stitching plane (ISSUE 12): `ingress` rides the serve
+# request trace when its submit carried a birth timestamp; the chain
+# trace's `head` stage is in CHAIN_STAGES above
+LATENCY_STAGES = _registry.SPAN_STAGES["latency"]
 
 
 def trace_enabled() -> bool:
@@ -63,9 +67,10 @@ class RequestTrace:
     """
 
     __slots__ = ("rid", "kind", "n_keys", "t_submit", "spans", "total_s",
-                 "ok", "pinned")
+                 "ok", "pinned", "flow", "flows")
 
-    def __init__(self, rid: int, kind: str, n_keys: int, t_submit: float):
+    def __init__(self, rid: int, kind: str, n_keys: int, t_submit: float,
+                 flow: Optional[int] = None):
         self.rid = rid
         self.kind = kind
         self.n_keys = n_keys
@@ -74,6 +79,12 @@ class RequestTrace:
         self.total_s: Optional[float] = None
         self.ok: Optional[bool] = None
         self.pinned = False
+        # gossip→head flow linkage (ISSUE 12): `flow` is the ingress trace
+        # id a SERVE request carries (the Chrome flow-event id emitted at
+        # its finalize); `flows` are the ids a CHAIN batch trace absorbs
+        # (the flow arrows terminate at its head stage)
+        self.flow = flow
+        self.flows: Tuple[int, ...] = ()
 
     def span_names(self):
         return {name for name, _, _ in self.spans}
@@ -122,10 +133,12 @@ class Tracer:
     # -- recording (service / vm hooks) -------------------------------------
 
     def begin(self, kind: str, n_keys: int,
-              t_submit: Optional[float] = None) -> RequestTrace:
+              t_submit: Optional[float] = None,
+              flow: Optional[int] = None) -> RequestTrace:
         if t_submit is None:
             t_submit = self.clock()
-        return RequestTrace(next(self._ids), kind, n_keys, t_submit)
+        return RequestTrace(next(self._ids), kind, n_keys, t_submit,
+                            flow=flow)
 
     def span(self, trace: RequestTrace, name: str, t0: float,
              t1: float) -> None:
@@ -146,9 +159,14 @@ class Tracer:
         trace.total_s = t_done - trace.t_submit
         with self._lock:
             # a trace begun before this tracer existed (explicit t_submit)
-            # must not export negative timestamps — rewind the epoch
-            if trace.t_submit < self._t0:
-                self._t0 = trace.t_submit
+            # must not export negative timestamps — rewind the epoch; an
+            # `ingress` span's birth timestamp can predate even t_submit
+            # (the item waited at the gossip layer), so the earliest span
+            # start participates in the rewind too
+            t_first = min((a for _name, a, _b in trace.spans),
+                          default=trace.t_submit)
+            if min(trace.t_submit, t_first) < self._t0:
+                self._t0 = min(trace.t_submit, t_first)
             self._finished += 1
             # pin BEFORE folding this total into the window: "over the
             # RUNNING p99" means the p99 of everything before this request
@@ -250,6 +268,27 @@ class Tracer:
                     "dur": round(max(0.0, b - a) * 1e6, 3),
                     "args": args,
                 })
+            # gossip→head flow links (ISSUE 12): a serve request carrying
+            # an ingress flow id STARTS the flow at the end of its last
+            # span (finalize); a chain batch trace that absorbed flow ids
+            # FINISHES each at the start of its last span (the head
+            # stage) — Perfetto then draws the arrow from the signature
+            # verdict to the head move it enabled
+            if tr.spans:
+                if tr.flow is not None:
+                    events.append({
+                        "name": "gossip_to_head", "cat": "latency",
+                        "ph": "s", "id": tr.flow, "pid": 1, "tid": tr.rid,
+                        "ts": self._us(max(b for _n, _a, b in tr.spans)),
+                    })
+                t_last_start = max(a for _n, a, _b in tr.spans)
+                for fid in tr.flows:
+                    events.append({
+                        "name": "gossip_to_head", "cat": "latency",
+                        "ph": "f", "bp": "e", "id": fid,
+                        "pid": 1, "tid": tr.rid,
+                        "ts": self._us(t_last_start),
+                    })
         for ex in execs:
             events.append({
                 "name": (f"vm[steps={ex['steps']},regs={ex['regs']},"

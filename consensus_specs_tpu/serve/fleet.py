@@ -435,8 +435,7 @@ class FleetRouter:
         rates, run the shed policy, apply (and journal) its decision."""
         self._reap_dead()
         self.poll_snapshots()
-        fleet_eval = self._fleet_tracker.evaluate(
-            hists=self.aggregator.merged_hists())
+        fleet_eval = self._fleet_tracker.evaluate(hists=self._slo_hists())
         worker_evals = {}
         for label in self.live_workers:
             tracker = self._worker_trackers.get(label)
@@ -527,17 +526,30 @@ class FleetRouter:
 
     def scrape_text(self) -> str:
         """The fleet-wide ``/metrics`` body: the merged worker view with
-        this process's own state (fleet.* gauges, recomputed slo.*)
-        overlaid."""
+        this process's own state (fleet.* gauges, recomputed slo.*, and
+        the router-side latency histograms — the chain plane's
+        end-to-end ``latency.gossip_to_head`` lives HERE when a
+        HeadService consumes the fleet's verdicts) overlaid."""
         self._export_gauges()  # fleet.* always current in any scrape
         local_stats, local_gauges = profiling.stats_and_gauges()
-        return self.aggregator.render_metrics(local_stats=local_stats,
-                                              local_gauges=local_gauges)
+        return self.aggregator.render_metrics(
+            local_stats=local_stats, local_gauges=local_gauges,
+            local_hists=profiling.latency_histograms())
+
+    def _slo_hists(self) -> Dict:
+        """Worker-merged histograms overlaid with this process's own —
+        ``latency.gossip_to_head`` lives in the ROUTER process when a
+        HeadService consumes the fleet's verdicts, so the SLO machinery
+        (burn rates, shedding, /healthz) must see it, not just /metrics."""
+        merged = self.aggregator.merged_hists()
+        for label, h in profiling.latency_histograms().items():
+            prev = merged.get(label)
+            merged[label] = h if prev is None else prev.merge(h)
+        return merged
 
     def healthz(self) -> Dict:
         """Fleet liveness + objective state over the MERGED histograms."""
-        evaluated = self._fleet_tracker.evaluate(
-            hists=self.aggregator.merged_hists())
+        evaluated = self._fleet_tracker.evaluate(hists=self._slo_hists())
         return {
             "ok": all(e["ok"] for e in evaluated.values()),
             "workers": self.live_workers,

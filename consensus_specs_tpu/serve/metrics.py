@@ -62,6 +62,10 @@ class ServeMetrics:
         self._mesh_devices_label = node_label("serve.mesh_devices", node)
         self._mesh_fallbacks_label = node_label("serve.mesh_fallbacks", node)
         self._ladder_rung_label = node_label("serve.ladder_rung", node)
+        self._deadline_flushes_label = node_label("serve.deadline_flushes",
+                                                  node)
+        self._deadline_budget_label = node_label("serve.deadline_budget_ms",
+                                                 node)
         self._lock = threading.Lock()
         self.submits = 0
         self.eager = 0  # resolved at submit time by the reference's own rules
@@ -84,6 +88,11 @@ class ServeMetrics:
         self.mesh_fallbacks = 0
         # commanded degradation-ladder rung (ISSUE 11 load shedding)
         self.ladder_rung = 0
+        # deadline-aware flush scheduling (ISSUE 12): flushes fired by
+        # the slot-budget rule instead of size-or-deadline, and the slot
+        # budget remaining (post-downstream-p99) at the latest one
+        self.deadline_flushes = 0
+        self.last_deadline_budget_ms = 0.0
         # prep-vs-device time split (the two pipeline stages): where a
         # flush's wall time goes — host codec prep or the device hard
         # part. device_flushes counts whole flushes (like prep_batches)
@@ -162,6 +171,17 @@ class ServeMetrics:
         with self._lock:
             self.ladder_rung = rung
         profiling.set_gauge(self._ladder_rung_label, rung)
+
+    def note_deadline_flush(self, budget_ms: float) -> None:
+        """One flush fired early by the slot-budget rule; ``budget_ms``
+        is the slot time that remained after subtracting the observed
+        downstream p99 (how close the deadline actually was)."""
+        with self._lock:
+            self.deadline_flushes += 1
+            self.last_deadline_budget_ms = budget_ms
+            count = self.deadline_flushes
+        profiling.set_gauge(self._deadline_flushes_label, count)
+        profiling.set_gauge(self._deadline_budget_label, round(budget_ms, 3))
 
     def note_mesh_fallback(self) -> None:
         with self._lock:
@@ -257,6 +277,9 @@ class ServeMetrics:
                 "mesh_devices": self.mesh_devices,
                 "mesh_fallbacks": self.mesh_fallbacks,
                 "ladder_rung": self.ladder_rung,
+                "deadline_flushes": self.deadline_flushes,
+                "last_deadline_budget_ms": round(
+                    self.last_deadline_budget_ms, 3),
                 "queue_depth_peak": self.queue_depth_peak,
                 "prep_batches": self.prep_batches,
                 "device_flushes": self.device_flushes,

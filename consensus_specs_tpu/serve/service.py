@@ -9,7 +9,9 @@ aggregate-verification loop (arXiv:2302.00418), and the fix is the same
 continuous-batching shape every inference-serving stack uses:
 
   submit() -> bounded ingress queue -> PREP stage forms a batch (flush on
-  max_batch OR max_wait_ms, whichever first) and runs the host codec
+  max_batch OR max_wait_ms OR — with CONSENSUS_SPECS_TPU_SLOT_MS arming a
+  slot clock — the most urgent item's remaining slot budget minus the
+  observed downstream p99, whichever first) and runs the host codec
   (ops/codec.py via prewarm_host_caches: batched decompression, subgroup
   checks, hash-to-G2) -> hand-off queue -> DEVICE stage groups requests
   by (kind, K bucket) so padded device shapes reuse the existing jit/VM
@@ -55,12 +57,69 @@ from collections import deque
 from concurrent.futures import Future
 from typing import List, Optional
 
-from ..obs import devices, flight, tracing
+from ..obs import devices, flight, latency, tracing
 from ..ops import profiling
 from .cache import ResultCache, check_key
 from .metrics import ServeMetrics
 
 KINDS = ("fast_aggregate", "aggregate")
+
+# slot duration in milliseconds arming the deadline-aware flush scheduler
+# (ISSUE 12): unset/0 keeps the classic size-OR-deadline flush; set, every
+# submit without an explicit deadline inherits "the end of the current
+# slot", and _collect flushes early when the remaining budget minus the
+# observed downstream p99 would otherwise be blown
+SLOT_MS_ENV = "CONSENSUS_SPECS_TPU_SLOT_MS"
+
+
+class SlotClock:
+    """Wall-clock slot grid for deadline-aware flushing.
+
+    The grid is anchored at ``origin`` (construction time by default) and
+    ticks every ``slot_s`` seconds; ``slot_end(t)`` is the absolute
+    perf-counter time the slot containing ``t`` closes — the latency
+    budget a gossip item born at ``t`` has to reach the head. One clock
+    can be shared by many services (reads only; the bench shares one grid
+    across all simnet nodes, which is what a real network does)."""
+
+    __slots__ = ("slot_s", "origin", "_clock")
+
+    def __init__(self, slot_s: float, clock=time.perf_counter,
+                 origin: Optional[float] = None):
+        assert slot_s > 0
+        self.slot_s = float(slot_s)
+        self._clock = clock
+        self.origin = clock() if origin is None else origin
+
+    @classmethod
+    def from_env(cls) -> Optional["SlotClock"]:
+        """A clock from ``CONSENSUS_SPECS_TPU_SLOT_MS``; None when unset,
+        zero, or malformed (a typo'd slot must degrade to the classic
+        flush rule, never crash service construction)."""
+        raw = (os.environ.get(SLOT_MS_ENV) or "").strip()
+        if not raw:
+            return None
+        try:
+            ms = float(raw)
+        except ValueError:
+            return None
+        return cls(ms / 1e3) if ms > 0 else None
+
+    def slot_index(self, t: Optional[float] = None) -> int:
+        if t is None:
+            t = self._clock()
+        return int((t - self.origin) // self.slot_s)
+
+    def slot_end(self, t: Optional[float] = None) -> float:
+        """Absolute time the slot containing ``t`` closes."""
+        if t is None:
+            t = self._clock()
+        return self.origin + (self.slot_index(t) + 1) * self.slot_s
+
+    def remaining(self, t: Optional[float] = None) -> float:
+        if t is None:
+            t = self._clock()
+        return self.slot_end(t) - t
 
 
 def _rlc_enabled() -> bool:
@@ -82,10 +141,10 @@ class QueueFull(RuntimeError):
 
 class _Pending:
     __slots__ = ("kind", "pubkeys", "messages", "signature", "key",
-                 "bucket", "future", "t_submit", "trace")
+                 "bucket", "future", "t_submit", "trace", "deadline")
 
     def __init__(self, kind, pubkeys, messages, signature, key, bucket,
-                 future, t_submit, trace=None):
+                 future, t_submit, trace=None, deadline=None):
         self.kind = kind
         self.pubkeys = pubkeys
         self.messages = messages
@@ -95,6 +154,9 @@ class _Pending:
         self.future = future
         self.t_submit = t_submit
         self.trace = trace  # obs.tracing.RequestTrace, or None (tracing off)
+        # absolute perf-counter time this item must have reached the head
+        # by (slot-clock-derived or caller-supplied); None = no budget
+        self.deadline = deadline
 
 
 class _CapturedOracle:
@@ -124,9 +186,18 @@ class VerificationService:
     def __init__(self, backend=None, oracle=None, *, max_batch: int = 256,
                  max_wait_ms: float = 20.0, max_queue: int = 4096,
                  cache_capacity: int = 1 << 16, backend_retries: int = 1,
-                 bucket_fn=None, tracer=None, node=None, mesh=None):
+                 bucket_fn=None, tracer=None, node=None, mesh=None,
+                 slot_clock=None, deadline_margin_ms: float = 2.0):
         assert max_batch > 0 and max_queue > 0
         self._backend = backend  # None: resolved lazily on first batch
+        # deadline-aware flush scheduling (ISSUE 12): an explicit
+        # ``slot_clock=`` wins; otherwise the env-armed grid
+        # (CONSENSUS_SPECS_TPU_SLOT_MS — None when unset keeps the
+        # classic size-OR-deadline flush untouched). The margin covers
+        # scheduling jitter between "flush fires" and "verdict lands".
+        self._slot_clock = (slot_clock if slot_clock is not None
+                            else SlotClock.from_env())
+        self._deadline_margin_s = max(0.0, deadline_margin_ms) / 1e3
         # verify-plane device mesh (ISSUE 9): acquired HERE, at
         # construction — an explicit ``mesh=`` wins, otherwise the
         # process-level provider (utils/jax_env.get_mesh, governed by
@@ -216,7 +287,10 @@ class VerificationService:
     # -- ingress ------------------------------------------------------------
 
     def submit(self, kind: str, pubkeys, messages, signature,
-               timeout: Optional[float] = None) -> "Future[bool]":
+               timeout: Optional[float] = None, *,
+               birth_s: Optional[float] = None,
+               flow_id: Optional[int] = None,
+               deadline_s: Optional[float] = None) -> "Future[bool]":
         """Enqueue one verification; returns a Future resolving to bool.
 
         The reference's no-crypto rules are answered eagerly, exactly as
@@ -226,12 +300,25 @@ class VerificationService:
 
         Backpressure: when the ingress queue is full, submit blocks until
         space frees (bounded by ``timeout`` seconds -> QueueFull).
+
+        Gossip→head stitching (ISSUE 12): ``birth_s`` is the item's
+        gossip-arrival perf-counter timestamp (records the ``ingress``
+        stage and, with tracing on, an ingress span); ``flow_id`` is its
+        end-to-end trace id (the Chrome flow link from this request's
+        span row to the chain batch that applies it); ``deadline_s`` is
+        an absolute head-by deadline — defaulted to the end of the
+        current slot when a slot clock is armed — that the flush
+        scheduler budgets against.
         """
         from ..utils import bls
 
         t0 = time.perf_counter()
         if kind not in KINDS:
             raise ValueError(f"unknown check kind {kind!r}")
+        if birth_s is not None:
+            latency.note_stage("ingress", max(0.0, t0 - birth_s))
+        if deadline_s is None and self._slot_clock is not None:
+            deadline_s = self._slot_clock.slot_end(t0)
         self.metrics.note_submit()
         fut: "Future[bool]" = Future()
         if not bls.bls_active:
@@ -292,11 +379,13 @@ class VerificationService:
                         f"{timeout}s"
                     )
                 self._not_full.wait(remaining)
-            tr = (self._tracer.begin(kind, len(pubkeys), t0)
+            tr = (self._tracer.begin(kind, len(pubkeys), t0, flow=flow_id)
                   if self._tracer is not None else None)
+            if tr is not None and birth_s is not None:
+                self._tracer.span(tr, "ingress", birth_s, t0)
             pend = _Pending(kind, pubkeys, messages, signature, key,
                             self._bucket_fn(max(1, len(pubkeys))), fut, t0,
-                            tr)
+                            tr, deadline=deadline_s)
             self._queue.append(pend)
             self._inflight[key] = pend
             self.metrics.note_enqueued(len(self._queue))
@@ -335,6 +424,11 @@ class VerificationService:
     def mesh_devices(self) -> int:
         """Devices the verify mesh spans (0 = single-device path)."""
         return self._mesh_devices
+
+    @property
+    def slot_clock(self) -> Optional[SlotClock]:
+        """The armed slot grid (None = classic size-OR-deadline flush)."""
+        return self._slot_clock
 
     @property
     def ladder_rung(self) -> int:
@@ -399,6 +493,7 @@ class VerificationService:
                                       items=len(batch))
             t1 = time.perf_counter()
             self.metrics.note_prep(t1 - t0)
+            latency.note_stage("prep", t1 - t0)
             if self._devices is not None:
                 # the prep stage's host-codec time on the dedicated host
                 # lane: the occupancy timeline then shows the pipeline
@@ -455,11 +550,37 @@ class VerificationService:
                     [p for p in batch if not p.future.done()]
                 )
 
+    def _budget_deadline_locked(self,
+                                downstream_s: float) -> Optional[float]:
+        """The slot-budget flush deadline: the earliest queued item's
+        head-by deadline minus the observed p99 of the stages it still
+        has to pay (prep/device/finalize) minus the margin. None when no
+        queued item carries a deadline (the classic flush rule alone
+        governs). Called under the service lock."""
+        earliest = None
+        for p in self._queue:
+            if p.deadline is not None and (earliest is None
+                                           or p.deadline < earliest):
+                earliest = p.deadline
+        if earliest is None:
+            return None
+        return earliest - downstream_s - self._deadline_margin_s
+
     def _collect(self) -> Optional[List[_Pending]]:
         """Block for work, then gather one batch: flush when ``max_batch``
         requests are waiting OR ``max_wait_ms`` has passed since the
-        OLDEST waiting request was submitted, whichever comes first.
-        Returns None when closed and fully drained."""
+        OLDEST waiting request was submitted OR — with a slot clock armed
+        (ISSUE 12) — the remaining slot budget of the most urgent queued
+        item, minus the live downstream p99, is about to be blown,
+        whichever comes first. Returns None when closed and fully
+        drained."""
+        # downstream p99 read OUTSIDE the service lock (it takes the
+        # profiling/histogram locks); refreshed once per collect — the
+        # number moves at flush cadence, not per wakeup
+        downstream_s = (latency.downstream_p99_s()
+                        if self._slot_clock is not None else 0.0)
+        deadline_flush = False
+        budget_remaining = 0.0
         with self._lock:
             while not self._queue:
                 if self._closed:
@@ -467,16 +588,34 @@ class VerificationService:
                 self._work.wait()
             deadline = self._queue[0].t_submit + self._max_wait_s
             while len(self._queue) < self._max_batch and not self._closed:
-                remaining = deadline - time.perf_counter()
-                if remaining <= 0:
+                budget = (self._budget_deadline_locked(downstream_s)
+                          if self._slot_clock is not None else None)
+                effective = (deadline if budget is None
+                             else min(deadline, budget))
+                now = time.perf_counter()
+                if effective - now <= 0:
+                    if budget is not None and budget < deadline:
+                        # the slot budget — not size, not max_wait —
+                        # fired this flush
+                        deadline_flush = True
+                        budget_remaining = max(0.0, budget - now)
                     break
-                self._work.wait(remaining)
+                self._work.wait(effective - now)
             n = min(self._max_batch, len(self._queue))
             batch = [self._queue.popleft() for _ in range(n)]
             self._staged += n
             profiling.set_gauge("serve.queue_depth", len(self._queue))
+        now = time.perf_counter()
+        for p in batch:
+            latency.note_stage("queue_wait", now - p.t_submit)
+        if deadline_flush:
+            self.metrics.note_deadline_flush(budget_remaining * 1e3)
+            if self._flight is not None:
+                self._flight.note(
+                    "serve", "deadline_flush", items=len(batch),
+                    budget_ms=round(budget_remaining * 1e3, 3),
+                    downstream_p99_ms=round(downstream_s * 1e3, 3))
         if self._tracer is not None:
-            now = time.perf_counter()
             for p in batch:
                 if p.trace is not None:
                     self._tracer.span(p.trace, "queue_wait", p.t_submit, now)
@@ -520,7 +659,9 @@ class VerificationService:
                 self._settle(pends, results)
         # whole-flush device time (all groups): the prep/device split is
         # per FLUSH on both sides, so the means share a denominator shape
-        self.metrics.note_device_flush(time.perf_counter() - t_flush)
+        device_s = time.perf_counter() - t_flush
+        self.metrics.note_device_flush(device_s)
+        latency.note_stage("device", device_s)
         self.metrics.export_gauges()
 
     def _verify_rlc(self, batch: List[_Pending]) -> Optional[List[bool]]:
@@ -548,10 +689,11 @@ class VerificationService:
             try:
                 t0 = time.perf_counter()
                 res = [bool(r) for r in rlc_fn(items, mesh=flush_mesh)]
+                t1 = time.perf_counter()
+                latency.note_stage("combine", t1 - t0)
                 if self._tracer is not None:
                     self._tracer.span_many((p.trace for p in batch),
-                                           "combine", t0,
-                                           time.perf_counter())
+                                           "combine", t0, t1)
                 return res
             except Exception as e:
                 self.metrics.note_mesh_fallback()
@@ -570,12 +712,13 @@ class VerificationService:
             try:
                 t0 = time.perf_counter()
                 res = [bool(r) for r in rlc_fn(items)]
+                t1 = time.perf_counter()
+                # the RLC combined check (bisection included when the
+                # combine failed and split) — nests inside `device`
+                latency.note_stage("combine", t1 - t0)
                 if self._tracer is not None:
-                    # the RLC combined check (bisection included when the
-                    # combine failed and split) — nests inside `device`
                     self._tracer.span_many((p.trace for p in batch),
-                                           "combine", t0,
-                                           time.perf_counter())
+                                           "combine", t0, t1)
                 return res
             except Exception:
                 pass
@@ -675,8 +818,9 @@ class VerificationService:
             self.metrics.note_result(now - p.t_submit)
             if not p.future.done():
                 p.future.set_result(bool(r))
+        t_end = time.perf_counter()
+        latency.note_stage("finalize", t_end - now)
         if self._tracer is not None:
-            t_end = time.perf_counter()
             for p, r in zip(pends, results):
                 if p.trace is not None:
                     self._tracer.span(p.trace, "finalize", now, t_end)

@@ -21,10 +21,11 @@ reach it (``advance_clock``); a partitioned node that hears nothing
 simply stays behind until the heal-time sync fast-forwards it, exactly
 like a real client rejoining.
 """
-from typing import Set
+from typing import Optional, Set
 
 from ..chain import HeadService
 from ..chain.metrics import ChainMetrics
+from ..obs import latency
 from ..obs.flight import FlightRecorder
 from ..serve.load import VerdictBackend
 from ..serve.service import VerificationService
@@ -34,11 +35,20 @@ __all__ = ["SimNode"]
 
 
 class SimNode:
-    """One simulated consensus node (index ``i``, name ``n<i>``)."""
+    """One simulated consensus node (index ``i``, name ``n<i>``).
+
+    ``service_kwargs`` / ``head_kwargs`` override the node's
+    VerificationService / HeadService construction knobs — the latency
+    bench (``bench.py --mode latency``) uses them to A/B the classic
+    size-OR-deadline flush against the slot-budget scheduler
+    (``slot_clock=``) and to arm speculative head application
+    (``speculative=True``) without touching the scenario scripts."""
 
     def __init__(self, index: int, spec, anchor_state, anchor_block,
                  shared_state, *, honest: bool = True, sim_clock=None,
-                 flight_capacity: int = 4096, backend=None):
+                 flight_capacity: int = 4096, backend=None,
+                 service_kwargs: Optional[dict] = None,
+                 head_kwargs: Optional[dict] = None):
         self.index = index
         self.name = f"n{index}"
         self.honest = honest
@@ -53,13 +63,16 @@ class SimNode:
         # every check to REAL worker processes instead — same verdict
         # rule, real process boundary
         self.backend = backend if backend is not None else VerdictBackend()
+        svc_kwargs = dict(max_batch=8, max_wait_ms=1.0)
+        svc_kwargs.update(service_kwargs or {})
         self.service = VerificationService(
-            backend=self.backend, max_batch=8, max_wait_ms=1.0,
-            node=self.name)
+            backend=self.backend, node=self.name, **svc_kwargs)
+        hd_kwargs = dict(differential=False)
+        hd_kwargs.update(head_kwargs or {})
         self.head = HeadService(
             spec, anchor_state, anchor_block, service=self.service,
             metrics=ChainMetrics(node=self.name), node=self.name,
-            recorder=self.recorder, differential=False)
+            recorder=self.recorder, **hd_kwargs)
         self._genesis_time = int(anchor_state.genesis_time)
         self._clock_slot = 0
         self._seen: Set[str] = set()
@@ -103,7 +116,13 @@ class SimNode:
             else:
                 self._import_block(block)
         else:
-            self.head.on_attestations([msg.payload])
+            # the gossip→head timeline's origin: the attestation is born
+            # (obs/latency.py) the wall-clock moment the fabric delivers
+            # it to THIS node — what lands in latency.gossip_to_head is
+            # the real processing+flush latency through the node's full
+            # serve/chain stack, deferral churn included
+            self.head.on_attestations([msg.payload],
+                                      births=[latency.birth()])
         return True
 
     def _import_block(self, block) -> None:
@@ -136,6 +155,9 @@ class SimNode:
             "reorgs": snap["reorgs"],
             "head_slot": snap["head_slot"],
             "deferred_pending": snap["deferred_pending"],
+            "speculative_applied": snap["speculative_applied"],
+            "rollbacks": snap["rollbacks"],
+            "deadline_flushes": self.service.metrics.deadline_flushes,
             "duplicates": self.duplicates,
             "backend_calls": self.backend.calls,
         }

@@ -314,12 +314,18 @@ def run_scenario(scenario: Scenario, *, spec=None, anchor_state=None,
                  events_per_epoch: Optional[int] = None,
                  strict: bool = True, flight_dir: Optional[str] = None,
                  query_rounds: int = 512,
-                 backend_factory=None) -> ScenarioReport:
+                 backend_factory=None,
+                 service_kwargs: Optional[dict] = None,
+                 head_kwargs: Optional[dict] = None) -> ScenarioReport:
     """Run one scenario end to end and gate it. ``strict`` raises
     :class:`SimDivergence` on any convergence failure; bench mode passes
     ``strict=False`` and reads ``report.converged``/``report.error``.
     ``flight_dir`` dumps one JSONL flight journal per node (always on
-    failure paths when set — the CI artifact)."""
+    failure paths when set — the CI artifact). ``service_kwargs`` /
+    ``head_kwargs`` override every node's VerificationService /
+    HeadService knobs (the latency bench's deadline-flush and
+    speculative-apply A/B runs) — the scenario script and the gate are
+    untouched by either."""
     from ..utils import bls
 
     if spec is None:
@@ -357,7 +363,8 @@ def run_scenario(scenario: Scenario, *, spec=None, anchor_state=None,
                 i, spec, anchor_state, anchor_block, anchor_state,
                 sim_clock=lambda: clock_box["now"],
                 backend=(backend_factory(f"n{i}")
-                         if backend_factory is not None else None)))
+                         if backend_factory is not None else None),
+                service_kwargs=service_kwargs, head_kwargs=head_kwargs))
 
         # -- schedule ---------------------------------------------------------
         for t, origin, msg in script.block_publishes:

@@ -587,3 +587,89 @@ def test_fleet_extract_shapes(bc):
     # error rounds and sections without rows extract nothing
     assert bc.extract_fleet({"parsed": {"error": "boom"}}) == {}
     assert bc.extract_fleet({"parsed": _parsed(300.0)}) == {}
+
+
+# -- latency state gate (ISSUE 12) --------------------------------------------
+
+
+def _latency_parsed(value, scenarios, **extra):
+    """A `bench.py --mode latency` line: {scenario: (ok, p99_ms)}."""
+    section = {}
+    for name, (ok, p99) in scenarios.items():
+        entry = {"ok": ok, "p99_ms": p99, "n": 128, "converged": ok,
+                 "improved": True}
+        if not ok:
+            entry["error"] = "objective violated"
+        section[name] = entry
+    return _parsed(value, mode="latency", n=None, k=None, latency=section,
+                   **extra)
+
+
+def test_latency_newly_violating_scenario_fails(tmp_path, bc, capsys):
+    """A scenario whose deadline-mode gossip_to_head_p99 met the declared
+    objective last round and violates it now fails outright — "LATENCY
+    SLO VIOLATED", the SLO-state mirror for the end-to-end plane."""
+    _write_round(tmp_path, 1, _latency_parsed(
+        25.0, {"latency_skew": (True, 40.0), "lossy_links": (True, 39.0)}))
+    _write_round(tmp_path, 2, _latency_parsed(
+        0.8, {"latency_skew": (False, 1250.0),
+              "lossy_links": (True, 41.0)}))
+    assert bc.main(["--dir", str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "cpu:latency:latency_skew" in out
+    assert "LATENCY SLO VIOLATED" in out
+
+
+def test_latency_p99_movement_is_report_only(tmp_path, bc, capsys):
+    """The per-scenario p99 milliseconds jitter on shared CPU hosts —
+    only the objective-state crossing fails the latency gate, never the
+    number moving within ok (the headline `value` keeps the ordinary
+    throughput gate, like every other mode)."""
+    _write_round(tmp_path, 1, _latency_parsed(
+        25.0, {"latency_skew": (True, 40.0)}))
+    _write_round(tmp_path, 2, _latency_parsed(
+        24.0, {"latency_skew": (True, 80.0)}))  # p99 2x worse, still met
+    assert bc.main(["--dir", str(tmp_path)]) == 0
+    assert "cpu:latency:latency_skew" in capsys.readouterr().out
+
+
+def test_latency_still_violated_is_not_a_new_failure(tmp_path, bc):
+    _write_round(tmp_path, 1, _latency_parsed(
+        25.0, {"lossy_links": (False, 1500.0)}))
+    _write_round(tmp_path, 2, _latency_parsed(
+        25.0, {"lossy_links": (False, 1600.0)}))
+    assert bc.main(["--dir", str(tmp_path)]) == 0
+
+
+def test_latency_keys_join_without_common_throughput_keys(tmp_path, bc,
+                                                          capsys):
+    """Shared latency keys are comparables in their own right (the
+    SLO/sim/mesh/fleet rule): disjoint throughput shapes still gate."""
+    _write_round(tmp_path, 1, _parsed(
+        1000.0, mode="head", n=None, k=None, blocks=1024,
+        latency={"latency_skew": {"ok": True, "p99_ms": 40.0}}))
+    _write_round(tmp_path, 2, _parsed(
+        900.0, mode="head", n=None, k=None, blocks=128,
+        latency={"latency_skew": {"ok": False, "p99_ms": 1250.0}}))
+    assert bc.main(["--dir", str(tmp_path)]) == 1
+    assert "LATENCY SLO VIOLATED" in capsys.readouterr().out
+
+
+def test_latency_new_scenarios_are_not_gated_until_seen(tmp_path, bc):
+    _write_round(tmp_path, 1, _latency_parsed(
+        25.0, {"latency_skew": (True, 40.0)}))
+    _write_round(tmp_path, 2, _latency_parsed(
+        25.0, {"latency_skew": (True, 40.0),
+               "lossy_links": (False, 1500.0)}))
+    assert bc.main(["--dir", str(tmp_path)]) == 0
+
+
+def test_latency_extract_shapes(bc):
+    doc = {"parsed": _latency_parsed(
+        25.0, {"latency_skew": (True, 40.5), "lossy_links": (True, 39.7)})}
+    assert bc.extract_latency(doc) == {
+        "cpu:latency:latency_skew": {"ok": True, "p99_ms": 40.5},
+        "cpu:latency:lossy_links": {"ok": True, "p99_ms": 39.7},
+    }
+    assert bc.extract_latency({"parsed": {"error": "boom"}}) == {}
+    assert bc.extract_latency({"parsed": _parsed(300.0)}) == {}

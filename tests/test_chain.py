@@ -290,6 +290,114 @@ def test_pruning_keeps_heads_and_shrinks(spec, genesis_state):
     m.check()
 
 
+# -- speculative apply / rollback differential (ISSUE 12) ---------------------
+
+
+def _twin_of(fc: ProtoForkChoice) -> ProtoForkChoice:
+    """A second fork choice replaying the same tree + checkpoints —
+    insertion order is preserved, so the arrays stay index-aligned."""
+    twin = ProtoForkChoice()
+    for node in fc.array._nodes:
+        parent_root = (fc.array._nodes[node.parent].root
+                       if node.parent is not None else None)
+        twin.on_block(node.root, parent_root, node.slot,
+                      node.justified_checkpoint, node.finalized_checkpoint)
+    twin.update_checkpoints(fc._justified, fc._finalized,
+                            dict(fc._balances))
+    return twin
+
+
+def _weights(fc: ProtoForkChoice):
+    return {n.root: n.weight for n in fc.array._nodes}
+
+
+def test_speculative_rollback_differential(spec, genesis_state):
+    """Randomized speculative-apply/rollback sequences (the ISSUE 12
+    satellite gate): a speculating twin applies EVERY batch's votes
+    before "verdicts", rolls the whole batch back whenever a random
+    subset "fails", and re-applies the passing votes — after every batch
+    its weights, head, and vote table must be bit-identical to the
+    never-speculated Mirror (which itself stays differential against
+    ``spec.get_head``). Repeated validators inside one batch exercise
+    the LIFO displacement-chain unwind."""
+    rng = random.Random(31)
+    m = Mirror(spec, genesis_state, rng)
+    spine = m.add_block(m.anchor_root, 1)
+    _grow_tree(m, rng, 24, max_slot=24, spine=spine)
+    m.check()
+    twin = _twin_of(m.fc)
+    n_validators = len(genesis_state.validators)
+
+    for batch_i in range(12):
+        # small validator pool => frequent intra-batch repeats
+        votes = [(rng.randrange(min(8, n_validators)), rng.choice(m.roots),
+                  rng.randint(0, 4)) for _ in range(8)]
+        failing = {i for i in range(len(votes)) if rng.random() < 0.35}
+
+        # speculating side: apply ALL votes, sweep (the speculative head
+        # exists and is never consulted by the oracle), then roll back
+        # everything on any failure and re-apply only the passing ones
+        tokens = []
+        for v, r, ep in votes:
+            _applied, tok = twin.speculate_latest_message(int(v), bytes(r),
+                                                          ep)
+            if tok is not None:
+                tokens.append(tok)
+        twin.apply()
+        if failing:
+            twin.rollback_latest_messages(tokens)
+            for i, (v, r, ep) in enumerate(votes):
+                if i not in failing:
+                    twin.on_latest_message(int(v), bytes(r), ep)
+
+        # oracle side: only the passing votes ever existed
+        for i, (v, r, ep) in enumerate(votes):
+            if i not in failing:
+                m.vote(v, r, ep)
+
+        if batch_i == 5:
+            # a checkpoint move with a perturbed balance set BETWEEN
+            # batches (the service contract: never inside one)
+            m.move_justified(1, spine, balance_shuffle=True)
+            twin.update_checkpoints(m.fc._justified, m.fc._finalized,
+                                    dict(m.fc._balances))
+        if batch_i == 8:
+            m.move_finalized(1, spine)
+            twin.update_checkpoints(m.fc._justified, m.fc._finalized,
+                                    dict(m.fc._balances))
+
+        twin.apply()
+        head = m.check()  # Mirror vs spec.get_head stays the outer gate
+        assert twin.head() == head
+        assert _weights(twin) == _weights(m.fc)
+        assert twin.votes == m.fc.votes
+
+
+def test_rollback_unwinds_intra_batch_displacement_chain():
+    """One validator speculated twice in one batch (epoch 2 then 3):
+    rolling back must restore the ORIGINAL vote, not the intermediate."""
+    fc = ProtoForkChoice()
+    a, b, c = b"a" * 32, b"b" * 32, b"c" * 32
+    fc.on_block(a, None, 0, (0, b""), (0, b""))
+    fc.on_block(b, a, 1, (0, b""), (0, b""))
+    fc.on_block(c, a, 1, (0, b""), (0, b""))
+    fc.update_checkpoints((0, a), (0, b""), {0: 100})
+    fc.on_latest_message(0, b, 1)
+    fc.apply()
+    assert fc.head() == b
+    before = _weights(fc)
+    tokens = []
+    for root, epoch in ((c, 2), (b, 3)):
+        _applied, tok = fc.speculate_latest_message(0, root, epoch)
+        tokens.append(tok)
+    assert fc.votes[0] == (b, 3)
+    assert fc.rollback_latest_messages(tokens) == 2
+    fc.apply()
+    assert fc.votes[0] == (b, 1)  # the pre-batch vote, not (c, 2)
+    assert _weights(fc) == before
+    assert fc.head() == b
+
+
 # -- proto-array unit behaviors ----------------------------------------------
 
 

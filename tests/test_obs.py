@@ -166,7 +166,11 @@ def _golden_tracer():
         return t["now"]
 
     tr = Tracer(capacity=16, exemplar_capacity=4, clock=clock)  # _t0=0.001
-    req = tr.begin("fast_aggregate", 2, t_submit=0.002)
+    # the serve request carries a gossip ingress record (ISSUE 12): an
+    # ingress span from its birth timestamp and flow id 7 — the Chrome
+    # flow link the chain batch below terminates
+    req = tr.begin("fast_aggregate", 2, t_submit=0.002, flow=7)
+    tr.span(req, "ingress", 0.0015, 0.002)
     tr.span(req, "queue_wait", 0.002, 0.004)
     tr.span(req, "prep", 0.004, 0.005)
     tr.span(req, "combine", 0.006, 0.008)
@@ -177,13 +181,16 @@ def _golden_tracer():
                       t0=0.005, seconds=0.003)
     # one chain-plane batch record (PR 5's validate/sig_wait/apply/sweep
     # stages — part of the golden schema since PR 7 so the trace-coverage
-    # gate below can hold every registered stage to an export)
+    # gate below can hold every registered stage to an export; the head
+    # stage + absorbed flow ids are the ISSUE 12 gossip→head stitching)
     chain = tr.begin("chain_apply", 3, t_submit=0.011)
     tr.span(chain, "validate", 0.011, 0.012)
     tr.span(chain, "sig_wait", 0.012, 0.014)
     tr.span(chain, "apply", 0.014, 0.015)
     tr.span(chain, "sweep", 0.015, 0.016)
-    tr.finish(chain, True, t_done=0.016)
+    tr.span(chain, "head", 0.016, 0.017)
+    chain.flows = (7,)
+    tr.finish(chain, True, t_done=0.017)
     obs_programs.note_assembly("hard_part[k=0,fold=32]", n_steps=4864,
                                n_regs=1024, seconds=1.5,
                                disk_cache_hit=False)
@@ -198,18 +205,31 @@ def test_chrome_export_schema():
     assert set(doc) == {"traceEvents", "displayTimeUnit", "programRegistry",
                         "otherData"}
     names = set()
+    flow_events = []
     for ev in doc["traceEvents"]:
-        assert ev["ph"] in ("X", "M")
+        # "s"/"f" are Chrome FLOW events (the ISSUE 12 gossip→head links)
+        assert ev["ph"] in ("X", "M", "s", "f")
         assert isinstance(ev["pid"], int)
         if ev["ph"] == "X":
             assert ev["ts"] >= 0 and ev["dur"] >= 0
             assert isinstance(ev["tid"], int)
             names.add(ev["name"])
-    # all five pipeline stages + the chain batch stages + the VM
-    # execution row made it out
+        elif ev["ph"] in ("s", "f"):
+            flow_events.append(ev)
+    # all five pipeline stages + the ingress hop + the chain batch stages
+    # + the VM execution row made it out
     assert set(STAGES) <= names
     assert set(CHAIN_STAGES) <= names
+    assert "ingress" in names and "head" in names
     assert any(n.startswith("vm[steps=256") for n in names)
+    # the flow arrow: ONE start (the serve request's finalize) and ONE
+    # finish (the chain batch's head stage) sharing id 7, start <= finish
+    starts = [e for e in flow_events if e["ph"] == "s"]
+    finishes = [e for e in flow_events if e["ph"] == "f"]
+    assert len(starts) == 1 and len(finishes) == 1
+    assert starts[0]["id"] == finishes[0]["id"] == 7
+    assert starts[0]["ts"] <= finishes[0]["ts"]
+    assert finishes[0]["bp"] == "e"
     reg = doc["programRegistry"]
     assert reg["vm_cache"] == {"disk_hits": 1, "disk_misses": 1}
     assert reg["programs"]["hard_part[k=0,fold=32]"]["vm_cache"] == "miss"
@@ -375,7 +395,8 @@ def test_exposition_scrapeable_under_load():
         health = json.loads(body)
         # the PR 7 /healthz upgrade: liveness + SLO state in one body
         assert status == 200 and health["ok"] is True
-        assert set(health["slo"]) == {"serve_p99", "chain_p99"}
+        assert set(health["slo"]) == {"serve_p99", "chain_p99",
+                                      "gossip_to_head_p99"}
         serve_slo = health["slo"]["serve_p99"]
         assert serve_slo["n"] > 0 and serve_slo["ok"] is True
         with pytest.raises(urllib.error.HTTPError):

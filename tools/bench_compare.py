@@ -60,6 +60,13 @@ correctness/availability regression), while ms/row movement — including
 a previously-winning device route going slower than host — is
 report-only.
 
+Latency gating: rounds that carry a ``latency`` section (`bench.py
+--mode latency` — per-scenario gossip→head rows under the adversarial
+simnet runs) gate on the same state rule: a scenario whose deadline-mode
+``gossip_to_head_p99`` met the declared objective (and converged) in the
+previous round and violates it in the newest fails the round outright
+("LATENCY SLO VIOLATED"); the p99 milliseconds are report-only.
+
 Output: the comparison table is also emitted as GitHub-flavored markdown
 — appended to ``$GITHUB_STEP_SUMMARY`` when CI sets it, printed to stdout
 otherwise — so the round-over-round numbers land on the workflow summary
@@ -235,6 +242,33 @@ def extract_fleet(doc):
     return out
 
 
+def extract_latency(doc):
+    """{``platform:latency:<scenario>``: {"ok", "p99_ms"}} from one
+    round's ``latency`` section (`bench.py --mode latency` — per-scenario
+    gossip→head rows: ``ok`` = converged AND the deadline-mode p99 met
+    the declared gossip_to_head_p99 objective)."""
+    parsed = doc.get("parsed")
+    if not isinstance(parsed, dict) or "error" in parsed:
+        return {}
+    section = parsed.get("latency")
+    if not isinstance(section, dict):
+        return {}
+    plat = _platform(parsed)
+    out = {}
+    for name, row in sorted(section.items()):
+        if not isinstance(row, dict) or "ok" not in row:
+            continue
+        try:
+            p99 = float(row.get("p99_ms") or 0.0)
+        except (TypeError, ValueError):
+            p99 = 0.0
+        out[f"{plat}:latency:{name}"] = {
+            "ok": bool(row.get("ok", False)),
+            "p99_ms": p99,
+        }
+    return out
+
+
 def extract_finalexp(doc):
     """{``platform:finalexp:<variant,rows>``: {"ok", "ms_per_row"}} from
     one round's ``finalexp`` section (`bench.py --mode finalexp` hard-part
@@ -318,6 +352,7 @@ def main(argv=None) -> int:
         new_mesh = extract_mesh(newest_doc)
         new_fx = extract_finalexp(newest_doc)
         new_fleet = extract_fleet(newest_doc)
+        new_lat = extract_latency(newest_doc)
     except (OSError, ValueError) as e:
         print(f"bench-compare: FAIL — {os.path.basename(newest)} unreadable: {e}")
         return 1
@@ -332,7 +367,7 @@ def main(argv=None) -> int:
         return 0
 
     prev_vals, prev_slo, prev_sim, prev_mesh = {}, {}, {}, {}
-    prev_fx, prev_fleet, prev_path = {}, {}, None
+    prev_fx, prev_fleet, prev_lat, prev_path = {}, {}, {}, None
     for path in reversed(files[:-1]):
         try:
             doc = _load(path)
@@ -342,18 +377,19 @@ def main(argv=None) -> int:
             prev_mesh = extract_mesh(doc)
             prev_fx = extract_finalexp(doc)
             prev_fleet = extract_fleet(doc)
+            prev_lat = extract_latency(doc)
         except (OSError, ValueError):
             prev_vals, prev_slo, prev_sim = {}, {}, {}
-            prev_mesh, prev_fx, prev_fleet = {}, {}, {}
+            prev_mesh, prev_fx, prev_fleet, prev_lat = {}, {}, {}, {}
         # an SLO-only or sim-only round (headline errored, objectives or
         # scenario matrix still recorded) is a usable baseline for its
         # state gate even with no throughput number
         if (prev_vals or prev_slo or prev_sim or prev_mesh or prev_fx
-                or prev_fleet):
+                or prev_fleet or prev_lat):
             prev_path = path
             break
     if not (prev_vals or prev_slo or prev_sim or prev_mesh or prev_fx
-            or prev_fleet):
+            or prev_fleet or prev_lat):
         print("bench-compare: SKIP — no earlier round recorded a usable value")
         return 0
 
@@ -363,8 +399,10 @@ def main(argv=None) -> int:
     mesh_common = sorted(set(new_mesh) & set(prev_mesh))
     fx_common = sorted(set(new_fx) & set(prev_fx))
     fleet_common = sorted(set(new_fleet) & set(prev_fleet))
+    lat_common = sorted(set(new_lat) & set(prev_lat))
     if (not common and not slo_common and not sim_common
-            and not mesh_common and not fx_common and not fleet_common):
+            and not mesh_common and not fx_common and not fleet_common
+            and not lat_common):
         # SLO keys count as comparables too: two rounds that share no
         # throughput shape but both declare serve_p99 must still gate the
         # objective state, not skip past it
@@ -483,6 +521,29 @@ def main(argv=None) -> int:
         if broke:
             failures.append(key)
 
+    # latency state gate (ISSUE 12): a scenario whose deadline-mode
+    # gossip_to_head_p99 met the declared objective last round and
+    # VIOLATES it (or stops converging / stops observing) now fails
+    # outright — "LATENCY SLO VIOLATED", the SLO-state mirror for the
+    # end-to-end plane; the p99 milliseconds themselves are report-only
+    # (CPU tail latencies jitter, the page-worthy event is the crossing)
+    for key in lat_common:
+        old, new = prev_lat[key], new_lat[key]
+        violated = old["ok"] and not new["ok"]
+        status = "LATENCY SLO VIOLATED" if violated else (
+            "ok" if new["ok"] else "still violated")
+        print(
+            f"  {key}: p99 {old['p99_ms']:.2f}ms -> {new['p99_ms']:.2f}ms "
+            f"(ok: {old['ok']} -> {new['ok']})"
+            f"{'  ' + status if violated else ''}"
+        )
+        rows.append((key, f"{old['p99_ms']:.2f}ms", f"{new['p99_ms']:.2f}ms",
+                     (new["p99_ms"] - old["p99_ms"]) / old["p99_ms"]
+                     if old["p99_ms"] else None,
+                     status))
+        if violated:
+            failures.append(key)
+
     # finalexp state gate: a hard-part variant cell that worked last round
     # and errors (or returns wrong verdicts) now fails outright — losing a
     # finalization variant is a correctness/availability regression; the
@@ -527,6 +588,8 @@ def main(argv=None) -> int:
            if fx_common else "")
         + (f", {len(fleet_common)} fleet worker count(s) gated"
            if fleet_common else "")
+        + (f", {len(lat_common)} latency scenario(s) gated"
+           if lat_common else "")
     )
     return 0
 
