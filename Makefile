@@ -21,7 +21,7 @@ CHEAP_GENERATORS = shuffling bls ssz_generic merkle
         clean_vectors generate_random_tests bench-compare check serve-trace head-bench docs \
         sim-bench sim-smoke serve-bench-mesh mesh-smoke clean rlc-bench \
         finalexp-bench finalexp-smoke native sweep serve-fleet-bench fleet-smoke \
-        latency-bench latency-smoke
+        latency-bench latency-smoke vmexec-bench vmexec-smoke
 
 # fast default: BLS stubbed except @always_bls, 4-way process-parallel
 # (reference `make test` = pytest -n 4, reference Makefile:100)
@@ -255,6 +255,29 @@ rlc-bench:
 finalexp-bench:
 	JAX_PLATFORMS=cpu python bench.py --mode finalexp
 
+# VM execution-backend race (ISSUE 13): the scan interpreter vs the fused
+# straight-line lowering (ops/vm_compile.py) on identical assembled
+# programs — warm ms/row both ways, fused trace/compile seconds, and
+# per-cell bit-identity, keyed `vmexec[kind,rows]`. First run on a
+# machine pays one XLA compile per (kind, rows) cell (persistent-cached
+# after); VMEXEC_KINDS/VMEXEC_ROWS resize. Cells are state-gated round
+# over round by tools/bench_compare.py ("VMEXEC ERRORED" — ms/row is
+# report-only). Running it also persists each program's measured winner
+# into .vm_cache — the verdict CONSENSUS_SPECS_TPU_VM_EXEC=auto adopts
+# (auto serves fused only for shapes a warm/pinned call has compiled).
+vmexec-bench:
+	JAX_PLATFORMS=cpu python bench.py --mode vmexec
+
+# execution-backend identity canary (CI, mirror of finalexp-smoke): the
+# fused straight-line lowering held to BIT-identity against the scan
+# interpreter AND the exact-int IR oracle (vm_analysis.eval_ir) over
+# registry programs at small assembly shapes (VMEXEC_SMOKE_FULL=1 runs
+# the full production-shape registry), batch axis included; dumps the
+# flight journal to vmexec_flight.jsonl on failure — uploaded as a CI
+# artifact. Kept out of tier-1: it pays real fused XLA compiles.
+vmexec-smoke:
+	JAX_PLATFORMS=cpu python -m consensus_specs_tpu.ops.vmexec_smoke
+
 # hard-part bit-identity canary (CI, mirror of mesh-smoke): the windowed
 # and Frobenius hard-part programs held to full-coefficient identity
 # against the exact-int host oracle over valid AND adversarial Fq12
@@ -280,6 +303,7 @@ clean:
 		mesh_flight.jsonl finalexp_flight.jsonl sim_flight/ \
 		fleet_flight.jsonl serve_flight.*.jsonl flight_dump.*.jsonl \
 		mesh_flight.*.jsonl finalexp_flight.*.jsonl fleet_flight.*.jsonl \
+		vmexec_flight.jsonl vmexec_flight.*.jsonl \
 		*-pid[0-9]*.jsonl
 
 # build the native kernels (csrc/): batched-SHA256 merkleization and the

@@ -570,6 +570,26 @@ def main():
         _emit_result(run_latency_bench())
         return
 
+    if _cli_mode() == "vmexec":
+        # VM execution-backend race (ISSUE 13): the scan interpreter vs
+        # the fused straight-line lowering (ops/vm_compile.py) on
+        # identical assembled programs, warm ms/row + trace/compile time
+        # per (kind, rows) cell, bit-identity checked per cell.
+        # CPU-forced; the `vmexec` section is state-gated round over
+        # round by tools/bench_compare.py ("VMEXEC ERRORED" — a kind
+        # losing its fused backend or the backends disagreeing bitwise
+        # fails the round; ms/row movement is report-only). Running this
+        # bench also persists each program's measured winner into its
+        # .vm_cache plan — the verdict CONSENSUS_SPECS_TPU_VM_EXEC=auto
+        # adopts for shapes a warm/pinned call has compiled.
+        from consensus_specs_tpu.utils.jax_env import force_cpu
+
+        force_cpu()
+        from consensus_specs_tpu.bench.vmexec import run_vmexec_bench
+
+        _emit_result(run_vmexec_bench())
+        return
+
     if _cli_mode() == "finalexp":
         # hard-part microbench (ISSUE 10): host-oracle HHT vs the VM
         # hard-part variants (bit_serial, windowed, frobenius) at

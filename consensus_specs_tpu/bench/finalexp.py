@@ -14,6 +14,12 @@ unitary rows, at rows in {1, 2, 4, 8} (FINALEXP_ROWS):
               width-for-depth flagship) — rows >= 2 fold onto the program
               row, so ms/row drops with pipelining.
 
+Plus the ISSUE 13 execution-backend cells: "frobenius_fused,<rows>" re-
+runs the frobenius variant under CONSENSUS_SPECS_TPU_VM_EXEC=fused (the
+straight-line lowering of ops/vm_compile.py, fold-1 + batch rows) at
+FINALEXP_FUSED_ROWS (default "1,8"); the `bars` gain fused_3x_<rows> —
+fused must beat the interpreted frobenius cell at the same rows >= 3x.
+
 Every VM execution's verdict must be True on the valid rows (an errored
 or wrong-verdict variant marks its cells ok=false — tools/bench_compare.py
 fails the round on a variant that worked last round, mirror of MESH
@@ -112,6 +118,60 @@ def run_finalexp_bench() -> dict:
             except Exception as e:
                 put(variant, r, 0.0, ok=False, err=f"{type(e).__name__}: {e}")
 
+    # fused-lowering race cells (ISSUE 13): the frobenius hard part run
+    # as a BACKEND race on the identical fold-1 program — the scan
+    # interpreter ("frobenius_interp1,<rows>") vs the fused straight-line
+    # lowering ("frobenius_fused,<rows>"), rows riding the batch axis
+    # both ways (under pinned `fused`, _fold_for collapses to 1: the
+    # straight-line stream has no idle lanes for folding to reclaim).
+    # The >=3x acceptance bars below compare this pair; the production-
+    # route comparison (fused vs the FOLDED interp cells above, the
+    # _FinalExpBatcher shape) is reported as fused_vs_pipelined — on the
+    # 2-core container the fold-8 interpreter keeps a 1.6x edge at 8
+    # rows, which is exactly why `auto` routes on measured ms/row per
+    # machine instead of pinning a winner. First fused call per shape
+    # pays the one-time trace+XLA compile (persistent-cached across
+    # processes) outside the timed reps.
+    fused_rows = [
+        int(x)
+        for x in os.environ.get("FINALEXP_FUSED_ROWS", "1,8").split(",")
+        if x and int(x) <= max_rows
+    ]
+    # these cells DECIDE the fused_3x bars, so the warm-floor estimate
+    # needs a tighter min than the report-only cells above: single-row
+    # fused wall time jitters ~25% on the 2-core container (min-of-1
+    # measured 2.86x on a program whose min-of-5 ratio is 3.7x) —
+    # FINALEXP_REPS still raises it further
+    race_reps = max(3, reps)
+    prev_exec = os.environ.get("CONSENSUS_SPECS_TPU_VM_EXEC")
+    try:
+        for variant, mode in (("frobenius_interp1", "interp"),
+                              ("frobenius_fused", "fused")):
+            os.environ["CONSENSUS_SPECS_TPU_VM_EXEC"] = mode
+            for r in fused_rows:
+                sub = g_rows[:r]
+                try:
+                    ok = bb._run_hard_part(
+                        sub, kind=variants["frobenius"], fold=1)
+                    if not ok.all():
+                        put(variant, r, 0.0, ok=False,
+                            err="wrong verdict on valid rows")
+                        continue
+                    dt = min(
+                        _timed(lambda: bb._run_hard_part(
+                            sub, kind=variants["frobenius"], fold=1))
+                        for _ in range(race_reps)
+                    )
+                    put(variant, r, dt * 1e3)
+                except Exception as e:
+                    put(variant, r, 0.0, ok=False,
+                        err=f"{type(e).__name__}: {e}")
+    finally:
+        if prev_exec is None:
+            os.environ.pop("CONSENSUS_SPECS_TPU_VM_EXEC", None)
+        else:
+            os.environ["CONSENSUS_SPECS_TPU_VM_EXEC"] = prev_exec
+
     # vmlint critical paths (fold-1 shapes), vs the legacy padded chain
     legacy_padded = 4864
     crit = {}
@@ -169,6 +229,21 @@ def run_finalexp_bench() -> dict:
         "assembler_4x": assembler["speedup"] >= 4.0,
         "cold_assembly_2s": new_s <= 2.0,
     }
+    # ISSUE 13 acceptance: the fused lowering must beat the interpreter
+    # on the IDENTICAL fold-1 program at the same rows by >= 3x (the
+    # backend race — same program, same inputs, bit-identical outputs).
+    # fused_vs_pipelined reports the production-route ratio against the
+    # folded interp cells (report-only: the fold-8 interpreter is a
+    # different program the auto route keeps available).
+    fused_vs_pipelined = {}
+    for r in fused_rows:
+        bars[f"fused_3x_{r}"] = bool(
+            ms("frobenius_interp1", r) and ms("frobenius_fused", r)
+            and ms("frobenius_interp1", r)
+            >= 3.0 * ms("frobenius_fused", r))
+        if ms("frobenius", r) and ms("frobenius_fused", r):
+            fused_vs_pipelined[str(r)] = round(
+                ms("frobenius", r) / ms("frobenius_fused", r), 2)
 
     best_rows = max(
         (r for r in rows_list

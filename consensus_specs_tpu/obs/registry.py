@@ -126,6 +126,14 @@ GAUGES: Dict[str, str] = {
                            "register-pressure hazard rule",
     "vm.analysis_max_live": "max register pressure (live values at one "
                             "step) across the analyzed programs",
+    "vm.fused_programs": "programs lowered to the fused straight-line "
+                         "backend in this process (ops/vm_compile.py; "
+                         "CONSENSUS_SPECS_TPU_VM_EXEC)",
+    "vm.fused_executions": "VM executions served by the fused lowering "
+                           "instead of the scan interpreter",
+    "vm.fused_fallbacks": "fused trace/compile/run failures that fell "
+                          "back to the interpreter (each journals a "
+                          "vm/fused_fallback flight event)",
     "bls.vm_cache_pruned_entries": "entries `make vm-cache-prune` evicted "
                                    "from .vm_cache/ (last prune in this "
                                    "process)",

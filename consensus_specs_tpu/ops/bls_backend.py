@@ -113,6 +113,15 @@ def _fold_for(kind: str, k: int, n_items: int = 1 << 30) -> int:
     so the register file stays modest for wide-committee buckets, and
     never exceeding the batch itself (a single verify must not pay for a
     mostly-filler folded program)."""
+    from . import vm_compile
+
+    if vm_compile.exec_mode() == "fused":
+        # the straight-line lowering has no idle lanes to saturate:
+        # folding only duplicates the op stream (F times the trace/compile
+        # and F times the per-level work on every row), while independent
+        # items vectorize for free on the batch axis — so a pinned fused
+        # mode always runs the fold-1 program at batch = n_items
+        return 1
     if kind == "hard_part":
         table = 32
     elif kind in ("hard_part_windowed", "hard_part_frobenius"):
@@ -207,6 +216,7 @@ def _program(kind: str, k: int = 0, fold: int = None) -> Tuple[vm.Program, int]:
             os.utime(path)  # mark touched: vm-cache-prune evicts by idle age
         except OSError:
             pass
+        _attach_fused_key(loaded, kind, k, fold)
         _note_program(kind, k, fold, loaded, time.perf_counter() - t0, True)
         return loaded, fold
     except Exception:
@@ -222,6 +232,7 @@ def _program(kind: str, k: int = 0, fold: int = None) -> Tuple[vm.Program, int]:
         pad_regs_to=_pow2(64),
         annotate=False,  # IR annotations are a vm_analysis concern
     )
+    _attach_fused_key(assembled, kind, k, fold)
     _note_program(kind, k, fold, assembled, time.perf_counter() - t0, False)
     try:
         tmp = f"{path}.{os.getpid()}.tmp"
@@ -233,21 +244,57 @@ def _program(kind: str, k: int = 0, fold: int = None) -> Tuple[vm.Program, int]:
     return assembled, fold
 
 
+def _attach_fused_key(assembled, kind: str, k: int, fold: int) -> None:
+    """Stamp the program's cache identity onto its schedule metadata so
+    the fused lowering (ops/vm_compile.py) can disk-cache its plan under
+    a matching ``.vm_cache`` key. Pre-meta pickles (meta=None) are left
+    untouched — they cannot lower fused anyway (no schedule metadata)."""
+    try:
+        if isinstance(assembled.meta, dict):
+            assembled.meta.setdefault(
+                "fused_key", (kind, k, fold, _program_fingerprint(kind)))
+    except Exception:
+        pass  # identity stamping is an optimization, never a failure
+
+
 _VM_CACHE_NAME_RE = None  # compiled lazily (module import stays light)
+_FUSED_CACHE_NAME_RE = None
 
 
 def _vm_cache_entry_stale(name: str) -> bool:
     """True when a ``.vm_cache`` entry can NEVER hit again in this source
     tree: its version prefix is not the current ``_VM_CACHE_VERSION``, or
     it names a known program kind whose per-program fingerprint has moved
-    (the builder was edited). Unknown kinds are kept — age/size still
-    bound them — so a checkout running older code is never sabotaged."""
-    global _VM_CACHE_NAME_RE
+    (the builder was edited). Fused lowering plans
+    (``fused_l<lowering>_v<cache>_<fp>_<kind>_…``) additionally re-key on
+    ``vm_compile.LOWERING_VERSION`` — a lowering change evicts every
+    fused artifact without touching the interpreter tensors, and vice
+    versa. Unknown kinds are kept — age/size still bound them — so a
+    checkout running older code is never sabotaged."""
+    global _VM_CACHE_NAME_RE, _FUSED_CACHE_NAME_RE
     if _VM_CACHE_NAME_RE is None:
         import re
 
         _VM_CACHE_NAME_RE = re.compile(
             r"^v(\d+)_([0-9a-f]+)_(.+)_k\d+_f\d+_w\d+x\d+_p\d+\.pkl$")
+        _FUSED_CACHE_NAME_RE = re.compile(
+            r"^fused_l(\d+)_v(\d+)_([0-9a-f]+)_(.+)_k\d+_f\d+"
+            r"_w\d+x\d+_p\d+_c\d+\.pkl$")
+    if name.startswith("fused_"):
+        m = _FUSED_CACHE_NAME_RE.match(name)
+        if not m:
+            return False
+        from . import vm_compile
+
+        lowering, version, fp, kind = (m.group(1), m.group(2), m.group(3),
+                                       m.group(4))
+        if int(lowering) != vm_compile.LOWERING_VERSION:
+            return True
+        if int(version) != _VM_CACHE_VERSION:
+            return True
+        if kind in vmlib.BUILDERS and fp != _program_fingerprint(kind):
+            return True
+        return False
     m = _VM_CACHE_NAME_RE.match(name)
     if not m:
         return False
@@ -739,8 +786,9 @@ class _FoldLayout:
 
     __slots__ = ("program", "fold", "rows", "nb")
 
-    def __init__(self, kind: str, k: int, n_items: int, mesh):
-        fold = _fold_for(kind, k, n_items)
+    def __init__(self, kind: str, k: int, n_items: int, mesh, fold=None):
+        if fold is None:
+            fold = _fold_for(kind, k, n_items)
         if mesh is not None:
             # the mesh pads rows up to the device count anyway, so folding
             # past ceil(n/devices) just runs a bigger program on filler
@@ -887,17 +935,19 @@ def _hard_part_kind(n_items: int) -> str:
 
 
 def _run_hard_part(g_flat_batch: np.ndarray, mesh=None,
-                   kind: str = None) -> np.ndarray:
+                   kind: str = None, fold: int = None) -> np.ndarray:
     """(N, 12, L) unitary g limb batch -> (N,) bool (res == 1). Counts N
     rows (padding included) against RLC_STATS['final_exps'] — the
     amortization ledger behind the serve plane's final-exps-per-item.
     ``kind`` overrides the variant route (_hard_part_kind) — the finalexp
-    bench races all three on identical rows."""
+    bench races all three on identical rows; ``fold`` pins the fold
+    factor (the bench's same-program backend race needs the interpreter
+    on the fold-1 shape the fused lowering runs)."""
     n = g_flat_batch.shape[0]
     RLC_STATS["final_exps"] += n
     if kind is None:
         kind = _hard_part_kind(n)
-    lay = _FoldLayout(kind, 0, n, mesh)
+    lay = _FoldLayout(kind, 0, n, mesh, fold=fold)
     L = fq.NUM_LIMBS
     gb = np.zeros((lay.nb, 12, L), dtype=np.uint64)
     gb[:n] = g_flat_batch

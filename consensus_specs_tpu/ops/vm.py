@@ -74,6 +74,26 @@ def _load_native_sched():
 
 
 _NATIVE_SCHED = _load_native_sched()
+_NATIVE_WARNED = False
+
+
+def _warn_native_missing() -> None:
+    """One line, once per process, when the native scheduling kernel is
+    not built: fresh clones otherwise silently run the ~1.2M ops/sec
+    pure-Python scheduler (and the >= 4x throughput smoke quietly drops
+    to its 2.5x fallback bar) instead of the ~3M ops/sec `make native`
+    path — a discoverability fix, never an error."""
+    global _NATIVE_WARNED
+    if _NATIVE_SCHED is None and not _NATIVE_WARNED:
+        _NATIVE_WARNED = True
+        import sys
+
+        print(
+            "vm: csrc/libvmsched.so not built — assembling with the "
+            "pure-Python scheduler (~1.2M ops/sec vs ~3M native); run "
+            "`make native` once per clone",
+            file=sys.stderr,
+        )
 
 
 def _native_schedule_alloc(kind_arr, a_all, b_all, w_mul, w_lin, outputs):
@@ -300,6 +320,7 @@ class Prog:
         skips it (`annotate=False`) — attribute writes on a million-op IR
         are a measurable slice of the assembly budget.
         """
+        _warn_native_missing()
         ops = self.ops
         n = len(ops)
         kind_l = [op.kind for op in ops]
@@ -952,23 +973,61 @@ def execute(program: Program, inputs: Dict[str, np.ndarray], batch_shape=(),
     limb arrays of shape batch_shape + (NUM_LIMBS,). Returns named outputs
     (loose, bounded < 2^382). With ``mesh``, the leading batch axis is
     sharded over ALL the mesh's axes (batch_shape[0] must divide by the
-    total device count)."""
-    from . import profiling
+    total device count).
+
+    Execution backend (CONSENSUS_SPECS_TPU_VM_EXEC): ``interp`` runs the
+    lax.scan interpreter below; ``fused`` runs the straight-line lowering
+    (ops/vm_compile.py — same schedule, no register file, bit-identical
+    outputs); ``auto`` (default) takes fused only when its artifact is
+    already compiled in-process for THIS batch shape and its measured
+    ms/row beats the interpreter's — auto never pays the cold fused
+    trace+compile bill mid-call (``warm_fused``/a pinned-``fused`` call/
+    the vmexec bench are what compile shapes). A
+    fused trace/compile/run failure falls back to the interpreter with a
+    ``vm/fused_fallback`` flight event — this entry point never fails for
+    lowering reasons."""
+    from . import profiling, vm_compile
 
     stacked = program.stack_inputs(inputs, tuple(batch_shape))
-    template = program.const_template()
-    instr = tuple(jnp.asarray(x) for x in program.instr)
     label = (
         f"vm[steps={program.n_steps},regs={program.n_regs},"
         f"batch={tuple(batch_shape)},sharded={mesh is not None}]"
     )
+    rows = 1
+    for d in batch_shape:
+        rows *= int(d)
+    path = "interp"
+    compile_inclusive = False
     t0 = time.perf_counter()
+    shape_sig = (tuple(int(d) for d in batch_shape), mesh is not None)
     with profiling.timed(label):
-        out = _execute_device(
-            stacked, template, program.input_regs, program.output_regs,
-            instr, mesh,
-        )
+        out = None
+        if vm_compile.use_fused(program, shape_sig=shape_sig):
+            try:
+                out, compile_inclusive = vm_compile.run_fused(
+                    program, stacked, mesh=mesh)
+                path = "fused"
+            except Exception as e:
+                vm_compile.note_fallback(program, e)
+                out = None
+        if out is None:
+            template = program.const_template()
+            instr = tuple(jnp.asarray(x) for x in program.instr)
+            out = _execute_device(
+                stacked, template, program.input_regs, program.output_regs,
+                instr, mesh,
+            )
+            # block BEFORE the timer stops: jax dispatch is async (CPU
+            # included), and the routing ledger below compares this dt
+            # against the fused path's (which blocks inside run_fused) —
+            # an unblocked interp dt records dispatch, not compute, and
+            # would pin ``auto`` on the interpreter forever
+            out.block_until_ready()
     dt = time.perf_counter() - t0
+    # per-program measured ms/row, per backend: the ledger the ``auto``
+    # route reads (fused first-shape calls are compile-inclusive and
+    # excluded; the stored value is the process-lifetime warm minimum)
+    vm_compile.note_execution(program, path, dt, rows, compile_inclusive)
     # span-trace plane (obs/tracing.py): VM executions ride the Chrome
     # trace export next to the serve pipeline's request spans. Opt-in —
     # the disabled cost is one env read per execute() (device-call scale,

@@ -60,6 +60,26 @@ B_CAP = 1 << (fq.LIMB_BITS * fq.NUM_LIMBS)
 COST_US_PER_STEP = 280.0
 COST_MODEL_REGS = 600.0
 
+# fused straight-line lowering cost model (ISSUE 13, ops/vm_compile.py):
+# the fused path pays only the REAL ops (no idle lanes, no register-file
+# gather/scatter), plus per-level stack/slice glue and per-chunk jit
+# dispatch. Constants fit to the measured g2_subgroup fold-1 warm row
+# (955 levels / 3417 muls / 5733 lins -> ~46 ms at chunk 24 on the
+# 2-core container; `make vmexec-bench` re-measures): the per-LEVEL term
+# dominates at fold 1 (XLA op-launch overhead of the straight-line
+# graphs), the per-mul SIMD work takes over on folded/wide programs.
+FUSED_COST_US_PER_MUL = 1.7
+FUSED_COST_US_PER_LIN = 0.25
+FUSED_COST_US_PER_LEVEL = 30.0
+FUSED_COST_US_PER_CHUNK = 250.0
+# default level-group size of the fused lowering: measured on CPU, XLA
+# compile time per level RISES with chunk size (superlinear passes over
+# the chunk graph: ~0.41 s/level at 24, ~0.5 s/level at 96) while warm
+# runtime is flat from 24 up (46.3 ms vs 47.2 ms for g2_subgroup) and
+# degrades sharply below (82.9 ms at 12 — dispatch + lost fusion), so 24
+# is the measured knee; CONSENSUS_SPECS_TPU_VM_FUSED_CHUNK overrides
+FUSED_CHUNK_STEPS = 24
+
 # live-range outlier rule: an ALU value is "long-lived" when its live range
 # exceeds max(LONG_RANGE_MIN_STEPS, LONG_RANGE_FRAC x scheduled steps). The
 # program is hazard-flagged when long-lived values OCCUPY the register file:
@@ -366,6 +386,16 @@ def check_cost(prog, assembled, w_mul: int, w_lin: int) -> Dict:
     predicted_row_s = (
         assembled.n_steps * COST_US_PER_STEP * 1e-6
         * (assembled.n_regs / COST_MODEL_REGS))
+    # fused-path prediction (ISSUE 13): the straight-line lowering pays the
+    # real per-level widths (sum over levels of mul/lin counts = n_mul /
+    # n_lin) plus per-level glue and per-chunk dispatch — never the idle
+    # lanes or the register-file traffic the interpreter model is built on
+    n_chunks = -(-sched_steps // FUSED_CHUNK_STEPS) if sched_steps else 0
+    predicted_fused_row_s = (
+        n_mul * FUSED_COST_US_PER_MUL
+        + n_lin * FUSED_COST_US_PER_LIN
+        + sched_steps * FUSED_COST_US_PER_LEVEL
+        + n_chunks * FUSED_COST_US_PER_CHUNK) * 1e-6
     return {
         "mul_ops": n_mul,
         "add_ops": n_add,
@@ -384,6 +414,8 @@ def check_cost(prog, assembled, w_mul: int, w_lin: int) -> Dict:
             if sched_steps else None),
         "mul_width_profile": profile,
         "predicted_row_s": round(predicted_row_s, 4),
+        "fused_chunks": n_chunks,
+        "predicted_fused_row_s": round(predicted_fused_row_s, 4),
     }
 
 
@@ -443,6 +475,154 @@ def program_stats(assembled) -> Optional[Dict]:
         "lin_fill_max": int(lin_fill.max()) if sched else 0,
         "max_reg_occupancy": int(occupancy.max()) if sched else 0,
         "alloc_regs": int(n_regs),
+    }
+
+
+# ---------------------------------------------------------------------------
+# compiler-backend API (ISSUE 13): the artifacts the fused straight-line
+# lowering (ops/vm_compile.py) consumes — derived from the instruction
+# TENSORS, so cache-loaded programs whose IR is gone lower fine too
+# ---------------------------------------------------------------------------
+
+
+def lowering_plan(assembled, chunk_steps: int = None) -> Dict:
+    """Per-level op lists + chunk-boundary live sets for the fused lowering.
+
+    For every scheduled level, the REAL (non-idle) lanes of each unit as
+    ``(a_regs, b_regs, dst_regs)`` columns (lin split into add/sub — the
+    is_sub flag becomes a static branch, not a runtime select), and every
+    ``chunk_steps`` levels an EXACT live-in register set from a backward
+    liveness pass over the schedule — the carry each traced level-group
+    function receives from the previous one.
+
+    Constant registers and the always-zero scratch register are excluded
+    from live sets while their PRELOADED value is the live one (the
+    lowering inlines constants as literals); a const register re-allocated
+    to an ALU value rejoins the carry from its redefinition onward.
+
+    Raises ``ValueError`` on pre-meta programs (old ``.vm_cache`` pickles
+    carry no schedule metadata) — callers fall back to the interpreter.
+    """
+    import numpy as np
+
+    if chunk_steps is None:
+        chunk_steps = FUSED_CHUNK_STEPS
+    chunk_steps = max(1, int(chunk_steps))
+    meta = assembled.meta
+    if not meta or "sched_steps" not in meta:
+        raise ValueError(
+            "program has no schedule metadata (pre-meta .vm_cache pickle) "
+            "— the fused lowering needs an assemble()-produced Program")
+    sched = int(meta["sched_steps"])
+    trash_mul, trash_lin = meta["trash_mul"], meta["trash_lin"]
+    msa, msb, msd, lsa, lsb, lsub, lsd = assembled.instr
+    const_regs = set(int(r) for r in assembled.const_regs)
+    out_regs = [int(r) for r in assembled.output_regs]
+
+    levels = []
+    n_mul = n_lin = 0
+    # first step at which each const register is redefined by an ALU op
+    # (register reuse): before that step its live value is the inlineable
+    # constant, from it onward the register carries a real value
+    const_redef: Dict[int, int] = {}
+    for t in range(sched):
+        mm = msd[t] < trash_mul
+        mul = (msa[t][mm].tolist(), msb[t][mm].tolist(),
+               msd[t][mm].tolist())
+        ll = lsd[t] < trash_lin
+        la, lb, ld, ls = lsa[t][ll], lsb[t][ll], lsd[t][ll], lsub[t][ll]
+        add = (la[~ls].tolist(), lb[~ls].tolist(), ld[~ls].tolist())
+        sub = (la[ls].tolist(), lb[ls].tolist(), ld[ls].tolist())
+        n_mul += len(mul[2])
+        n_lin += len(add[2]) + len(sub[2])
+        for d in mul[2] + add[2] + sub[2]:
+            if d in const_regs and d not in const_redef:
+                const_redef[d] = t
+        levels.append({"mul": mul, "add": add, "sub": sub})
+
+    def _carryable(reg: int, boundary: int) -> bool:
+        """Whether ``reg``'s live value at ``boundary`` must ride the
+        carry: yes unless it is the scratch zero or a still-preloaded
+        constant (both inlined by the lowering)."""
+        if reg == 0:
+            return False
+        if reg in const_regs:
+            return const_redef.get(reg, sched) < boundary
+        return True
+
+    starts = list(range(0, sched, chunk_steps))
+    live = set(out_regs)
+    live_in: List[List[int]] = [[] for _ in starts]
+    for t in range(sched - 1, -1, -1):
+        lv = levels[t]
+        for unit in ("mul", "add", "sub"):
+            live.difference_update(lv[unit][2])
+        for unit in ("mul", "add", "sub"):
+            live.update(lv[unit][0])
+            live.update(lv[unit][1])
+        if t % chunk_steps == 0:
+            ci = t // chunk_steps
+            live_in[ci] = sorted(r for r in live if _carryable(r, t))
+    chunks = [
+        {"start": s, "stop": min(s + chunk_steps, sched),
+         "live_in": live_in[i]}
+        for i, s in enumerate(starts)
+    ]
+    return {
+        "sched_steps": sched,
+        "chunk_steps": chunk_steps,
+        "levels": levels,
+        "chunks": chunks,
+        "inputs": [int(r) for r in assembled.input_regs],
+        "outputs": out_regs,
+        "consts": {int(r): v for r, v in assembled.const_regs.items()},
+        "n_mul": n_mul,
+        "n_lin": n_lin,
+    }
+
+
+_N_PRIME = None  # -p^-1 mod R, computed lazily for eval_ir
+
+
+def eval_ir(prog, inputs: Dict[str, int]) -> Dict[str, int]:
+    """Exact-int oracle of the VM semantics over the IR: every value as
+    the exact (loose, Montgomery-domain) INTEGER the device computes —
+    mul is the Montgomery reduction ``(t + M*p) / R`` with
+    ``M = (t * -p^-1) mod R``, add is exact, sub is the borrowless
+    ``a + MP - b`` form. ``inputs`` are field integers (< p), encoded to
+    the Montgomery domain here exactly like ``fq.to_mont_int``.
+
+    The vmexec smoke holds BOTH execution backends (interpreter and fused
+    lowering) to these integers with full limb identity — a stronger
+    contract than mod-p agreement, since it pins the loose representative
+    every downstream consumer (combine feeds, ``inp(bound=)`` chains)
+    actually receives."""
+    global _N_PRIME
+    if _N_PRIME is None:
+        _N_PRIME = (-pow(fq.P, -1, fq.R_MONT)) % fq.R_MONT
+    name_of = dict(zip(prog.inputs, prog.input_names))
+    vals: List[int] = [0] * len(prog.ops)
+    for i, op in enumerate(prog.ops):
+        if op.kind == -1:
+            x = inputs[name_of[i]]
+            if not 0 <= x < fq.P:
+                raise ValueError(f"input {name_of[i]!r} not a field int")
+            vals[i] = (x * fq.R_MONT) % fq.P
+        elif op.kind == -2:
+            vals[i] = (op.a * fq.R_MONT) % fq.P
+        elif op.kind == _MUL:
+            t = vals[op.a] * vals[op.b]
+            m = (t * _N_PRIME) % fq.R_MONT
+            vals[i] = (t + m * fq.P) // fq.R_MONT
+        elif op.kind == _ADD:
+            vals[i] = vals[op.a] + vals[op.b]
+        elif op.kind == _SUB:
+            vals[i] = vals[op.a] + fq.MP - vals[op.b]
+        else:
+            raise ValueError(f"unknown op kind {op.kind}")
+    return {
+        name: vals[idx]
+        for name, idx in zip(prog.output_names, prog.outputs)
     }
 
 
@@ -609,6 +789,11 @@ def baseline_entry(report: Dict) -> Dict:
         "max_live": report["pressure"]["max_live"],
         "alloc_regs": report["pressure"]["alloc_regs"],
         "mul_ops": report["cost"]["mul_ops"],
+        # informational (NOT in BASELINE_KEYS — model constants move with
+        # re-measurement): the fused-vs-interp prediction pair the ISSUE 13
+        # lowering decision reads off the committed baseline
+        "predicted_row_s": report["cost"]["predicted_row_s"],
+        "predicted_fused_row_s": report["cost"]["predicted_fused_row_s"],
     }
 
 

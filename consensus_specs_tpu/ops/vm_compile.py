@@ -1,0 +1,526 @@
+"""Fused straight-line lowering of field-ALU VM programs (ISSUE 13).
+
+WHY A SECOND LOWERING. The scan interpreter (ops/vm.py) pays a fixed
+per-step cost that has nothing to do with the math: every step gathers
+full lane-width operand blocks out of a ~600-register file, runs the ALU
+over EVERY lane (idle ones included — the hard part fills ~5% of the mul
+lanes), and scatters the results back with a whole-register-file copy.
+Measured at ~280 µs/step, the interpreter — not the field arithmetic —
+is the device-side bottleneck (frobenius hard part: 1840 steps ≈ 0.5 s/row
+on CPU vs ~20 ms for the same ops in the host oracle).
+
+This module compiles the SAME assembled program (the exact schedule the
+interpreter would run, via ``ops/vm_analysis.lowering_plan``) into
+straight-line jax code:
+
+  - one SSA value per real op — no register file, no dynamic indexing,
+    no idle lanes: each scheduled level stacks exactly its live operands
+    and runs ONE vectorized ``fq.mont_mul_u64`` / carry-add over them;
+  - constants inlined as literals, the is_sub flag lowered to a static
+    add/sub split (no runtime select);
+  - level groups CHUNKED (``CONSENSUS_SPECS_TPU_VM_FUSED_CHUNK`` levels
+    per traced+jitted function, default ``vm_analysis.FUSED_CHUNK_STEPS``)
+    so trace/compile time stays bounded for the 1840-4864-level hard-part
+    programs; one carry array (the exact backward-liveness live set) rides
+    between chunks, device-resident throughout.
+
+Outputs are BIT-IDENTICAL to the interpreter: the per-op integer
+functions (Montgomery reduce / carry add / borrowless sub) are the same
+exact maps, and tests + the vmexec smoke hold both backends to the
+exact-int IR oracle (``vm_analysis.eval_ir``) limb for limb.
+
+Routing (``CONSENSUS_SPECS_TPU_VM_EXEC``): ``interp`` pins the scan VM,
+``fused`` pins this lowering, ``auto`` (default) runs fused only when
+the artifact is ALREADY COMPILED in-process for the requested batch
+shape AND the measured warm-ms/row pair (in-process ledger, seeded from
+the ``.vm_cache`` plan's persisted measurements) says fused wins:
+nothing changes for a cold machine until a bench (`make vmexec-bench`),
+an explicit ``warm_fused``, or a pinned-``fused`` call has compiled the
+shape and proven the win — auto never eats the minutes-scale cold
+XLA bill in the middle of a call. Any trace/compile/run failure falls
+back to the interpreter with a ``vm/fused_fallback`` flight event; the
+Pallas dispatch modes keep the scan path (a pallas_call is its own fused
+story). The batch axis semantics match ``vm.execute`` exactly — under a
+``mesh`` the carry is sharded over the mesh's axes and every chunk stays
+batch-elementwise, so PR 9's sharded Miller loops and PR 10's
+``_FinalExpBatcher`` ride either backend unchanged.
+
+Fused plans are disk-cached next to the interpreter tensors under
+``.vm_cache/`` with their own ``fused_l<LOWERING_VERSION>_…`` key
+component, so a lowering change re-keys fused artifacts without touching
+the interpreter pickles (``prune_vm_cache`` evicts stale ones).
+"""
+import os
+import time
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import fq, vm, vm_analysis
+
+# bump when the lowering's emitted code or plan format changes: re-keys
+# every fused .vm_cache artifact independently of the interpreter tensors
+LOWERING_VERSION = 1
+
+
+def exec_mode() -> str:
+    """CONSENSUS_SPECS_TPU_VM_EXEC, normalized (interp | fused | auto)."""
+    v = os.environ.get("CONSENSUS_SPECS_TPU_VM_EXEC", "auto")
+    return v if v in ("interp", "fused", "auto") else "auto"
+
+
+def chunk_steps() -> int:
+    """Scheduled levels per traced chunk function
+    (CONSENSUS_SPECS_TPU_VM_FUSED_CHUNK, default
+    vm_analysis.FUSED_CHUNK_STEPS)."""
+    try:
+        v = int(os.environ.get("CONSENSUS_SPECS_TPU_VM_FUSED_CHUNK", "0"))
+    except ValueError:
+        v = 0
+    return v if v > 0 else vm_analysis.FUSED_CHUNK_STEPS
+
+
+# lowering-plane observability: compiled plans, fused executions, and
+# interpreter fallbacks — exported as vm.fused_* gauges
+_COUNTERS = {"programs": 0, "executions": 0, "fallbacks": 0}
+
+
+def _export_gauges() -> None:
+    from . import profiling
+
+    profiling.set_gauge("vm.fused_programs", _COUNTERS["programs"])
+    profiling.set_gauge("vm.fused_executions", _COUNTERS["executions"])
+    profiling.set_gauge("vm.fused_fallbacks", _COUNTERS["fallbacks"])
+
+
+# ---------------------------------------------------------------------------
+# chunk emission
+# ---------------------------------------------------------------------------
+
+
+def _make_chunk_fn(levels, in_layout, out_layout, consts, first: bool):
+    """One straight-line level-group function: carry (batch, n_in, L) ->
+    (batch, n_out, L). ``consts`` maps register -> preloaded Montgomery
+    limb array (inlined as literals); the always-zero scratch register
+    inlines zeros. ``first`` marks the chunk fed the compact u32 input
+    stack (widened to the u64 compute dtype on device).
+
+    The add and sub lanes of a level share ONE stacked carry-propagation
+    (adds first, then the borrowless-complement subs) — the compile-time
+    budget of these graphs is per-HLO-op, and the carry chain is the
+    single biggest op block after mont_mul, so halving its count cuts XLA
+    compile measurably. Per-lane math is unchanged: identical to the
+    interpreter's ``a + (is_sub ? (MP+1)+(MASK-b) : b)``, carried."""
+    pos = {r: i for i, r in enumerate(in_layout)}
+    mp1 = np.asarray(vm._MP_PLUS_1)
+    L = fq.NUM_LIMBS
+
+    def fn(carry):
+        if first:
+            carry = carry.astype(jnp.uint64)
+        batch = carry.shape[:-2]
+        env: Dict[int, jnp.ndarray] = {}
+
+        def get(r):
+            v = env.get(r)
+            if v is None:
+                i = pos.get(r)
+                if i is not None:
+                    v = carry[..., i, :]
+                elif r in consts:
+                    v = jnp.broadcast_to(
+                        jnp.asarray(consts[r]), batch + (L,))
+                elif r == 0:
+                    v = jnp.zeros(batch + (L,), dtype=jnp.uint64)
+                else:
+                    raise KeyError(
+                        f"fused lowering: register {r} has no value in "
+                        "this chunk (lowering-plan liveness bug)")
+                env[r] = v
+            return v
+
+        for lv in levels:
+            new: Dict[int, jnp.ndarray] = {}
+            ma, mb, md = lv["mul"]
+            if md:
+                a = jnp.stack([get(r) for r in ma], axis=-2)
+                b = jnp.stack([get(r) for r in mb], axis=-2)
+                m = fq.mont_mul_u64(a, b)
+                for j, d in enumerate(md):
+                    new[d] = m[..., j, :]
+            aa, ab, ad = lv["add"]
+            sa, sb, sd = lv["sub"]
+            if ad or sd:
+                la = jnp.stack([get(r) for r in aa + sa], axis=-2)
+                lb = jnp.stack([get(r) for r in ab + sb], axis=-2)
+                if sd:
+                    comp = mp1 + (jnp.uint64(fq.MASK) - lb[..., len(ad):, :])
+                    rhs = (jnp.concatenate(
+                        [lb[..., :len(ad), :], comp], axis=-2)
+                        if ad else comp)
+                else:
+                    rhs = lb
+                s = fq._carry_limbs(la + rhs, out_limbs=L + 1)[..., :L]
+                for j, d in enumerate(ad + sd):
+                    new[d] = s[..., j, :]
+            # defs become visible at the NEXT level only (the interpreter
+            # reads the pre-step register file) — update after all units
+            env.update(new)
+        if not out_layout:
+            return jnp.zeros(batch + (0, L), dtype=jnp.uint64)
+        return jnp.stack([get(r) for r in out_layout], axis=-2)
+
+    return fn
+
+
+class FusedProgram:
+    """Compiled artifact: the chunked straight-line functions for one
+    assembled Program at one lowering-plan chunking."""
+
+    def __init__(self, program: "vm.Program", plan: Dict):
+        self.program = program
+        self.plan = plan
+        self.seen_shapes = set()  # (batch_shape, sharded) already traced
+        self.compile_s: Dict[tuple, float] = {}  # batch -> AOT wall secs
+        consts = {
+            int(r): fq.to_mont_int(v) for r, v in plan["consts"].items()
+        }
+        chunks = plan["chunks"]
+        levels = plan["levels"]
+        fns = []
+        in_counts = []
+        if not chunks:
+            # zero scheduled steps: outputs select straight off the inputs
+            fns.append(jax.jit(_make_chunk_fn(
+                [], plan["inputs"], plan["outputs"], consts, True)))
+            in_counts.append(len(plan["inputs"]))
+        for ci, ch in enumerate(chunks):
+            in_layout = plan["inputs"] if ci == 0 else ch["live_in"]
+            out_layout = (chunks[ci + 1]["live_in"]
+                          if ci + 1 < len(chunks) else plan["outputs"])
+            fns.append(jax.jit(_make_chunk_fn(
+                levels[ch["start"]:ch["stop"]], in_layout, out_layout,
+                consts, ci == 0)))
+            in_counts.append(len(in_layout))
+        self._fns = fns
+        self._in_counts = in_counts
+        self._aot: Dict[tuple, List] = {}  # batch shape -> compiled chunks
+
+    def warm(self, batch: tuple) -> float:
+        """Trace + XLA-compile every chunk for one (unsharded) batch
+        shape through the AOT API: each chunk's input shape is statically
+        known from its live-in layout, so the whole pipeline compiles
+        without running anything. Returns the wall seconds (0.0 when
+        already compiled in-process) — the number the vmexec bench
+        reports next to each warm cell. Compiled executables land in the
+        persistent XLA cache, so a later process skips the XLA backend
+        compile for the same (program, shape) — it still pays jax
+        trace+lowering per chunk (~0.1 s/level measured, ~4x under the
+        cold bill). Chunks compile SEQUENTIALLY on purpose: XLA CPU
+        serializes compilation behind a global lock in this jax build (a
+        2-thread pool measured SLOWER than sequential), so a pool would
+        only add overhead."""
+        key = tuple(batch)
+        if key in self._aot:
+            return 0.0
+        t0 = time.perf_counter()
+        compiled = []
+        for i, fn in enumerate(self._fns):
+            dtype = jnp.uint32 if i == 0 else jnp.uint64
+            spec = jax.ShapeDtypeStruct(
+                key + (self._in_counts[i], fq.NUM_LIMBS), dtype)
+            compiled.append(fn.lower(spec).compile())
+        self._aot[key] = compiled
+        dt = time.perf_counter() - t0
+        self.compile_s[key] = dt
+        return dt
+
+    def run(self, stacked_u32: np.ndarray, mesh=None) -> jnp.ndarray:
+        carry = jnp.asarray(stacked_u32)
+        if mesh is not None:
+            # sharded path: plain jitted chunk functions — GSPMD
+            # propagates the batch-axis sharding through the (purely
+            # batch-elementwise) straight-line graphs, zero collectives
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            carry = jax.device_put(
+                carry, NamedSharding(mesh, P(mesh.axis_names)))
+            for fn in self._fns:
+                carry = fn(carry)
+            return carry
+        fns = self._aot.get(carry.shape[:-2])
+        if fns is None:
+            self.warm(carry.shape[:-2])
+            fns = self._aot[carry.shape[:-2]]
+        for fn in fns:
+            carry = fn(carry)
+        return carry
+
+
+# id(program) -> FusedProgram; values hold the program strongly, so a
+# live entry's id can never be recycled by a different Program
+_FUSED: Dict[int, FusedProgram] = {}
+
+
+def _plan_cache_path(program) -> Optional[str]:
+    """Disk path for this program's lowering plan, or None when the
+    program carries no cache identity (directly-assembled test programs,
+    pre-meta pickles). The name's ``fused_l<ver>`` prefix is the
+    lowering-version cache-key component: fused artifacts re-key
+    independently of the interpreter tensors, and ``prune_vm_cache``
+    evicts entries whose lowering version or program fingerprint moved."""
+    meta = program.meta or {}
+    key = meta.get("fused_key")
+    if not key:
+        return None
+    kind, k, fold, fp = key
+    from . import bls_backend as bb
+
+    return os.path.join(
+        bb._vm_cache_dir(),
+        f"fused_l{LOWERING_VERSION}_v{bb._VM_CACHE_VERSION}_{fp}_{kind}"
+        f"_k{k}_f{fold}_w{meta.get('w_mul', 0)}x{meta.get('w_lin', 0)}"
+        f"_p{program.n_steps}_c{chunk_steps()}.pkl",
+    )
+
+
+def _load_plan(program) -> Optional[Dict]:
+    """The disk-cached lowering plan for ``program`` at the CURRENT chunk
+    setting, or None (absent, unreadable, stale chunking)."""
+    import pickle
+
+    path = _plan_cache_path(program)
+    if path is None:
+        return None
+    try:
+        with open(path, "rb") as fh:
+            plan = pickle.load(fh)
+        if (plan.get("sched_steps") is not None
+                and plan.get("chunk_steps") == chunk_steps()):
+            try:
+                os.utime(path)  # prune evicts by idle age
+            except OSError:
+                pass
+            return plan
+    except Exception:
+        pass
+    return None
+
+
+def _store_plan(program, plan: Dict) -> None:
+    import pickle
+
+    path = _plan_cache_path(program)
+    if path is None:
+        return
+    try:
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "wb") as fh:
+            pickle.dump(plan, fh)
+        os.replace(tmp, path)
+    except Exception:
+        pass  # the disk cache is an optimization only
+
+
+def _seed_stats_from_plan(program, plan: Dict) -> None:
+    """Adopt the plan's persisted warm-ms/row measurements into the
+    in-process ledger (keeping any better number this process measured) —
+    this is what lets a FRESH process's ``auto`` route serve the winner a
+    past bench proved (once a shape is warmed) instead of re-measuring
+    the interpreter per process."""
+    meas = plan.get("measured")
+    if not isinstance(meas, dict):
+        return
+    st = getattr(program, "_exec_stats", None)
+    if st is None:
+        st = {}
+        program._exec_stats = st
+    for key in ("interp_ms_row", "fused_ms_row"):
+        v = meas.get(key)
+        if v is not None and (st.get(key) is None or v < st[key]):
+            st[key] = float(v)
+
+
+def fused_program(program, plan: Dict = None) -> FusedProgram:
+    """The compiled fused artifact for ``program`` (derive-or-load the
+    lowering plan, build the chunk functions; XLA compiles lazily on the
+    first call per batch shape)."""
+    fp = _FUSED.get(id(program))
+    if fp is None:
+        t0 = time.perf_counter()
+        if plan is None:
+            plan = _load_plan(program)
+        if plan is None:
+            plan = vm_analysis.lowering_plan(program,
+                                             chunk_steps=chunk_steps())
+            _store_plan(program, plan)
+        _seed_stats_from_plan(program, plan)
+        fp = FusedProgram(program, plan)
+        _FUSED[id(program)] = fp
+        _COUNTERS["programs"] += 1
+        _export_gauges()
+        try:
+            from ..obs import flight
+
+            flight.note(
+                "vm", "fused_compile",
+                steps=int(program.n_steps),
+                chunks=len(plan["chunks"]),
+                plan_seconds=round(time.perf_counter() - t0, 4),
+            )
+        except Exception:
+            pass
+    return fp
+
+
+def use_fused(program, mode: str = None, shape_sig: tuple = None) -> bool:
+    """Route decision for one execution. ``fused`` always takes this
+    lowering (compiling on demand); ``auto`` only when BOTH hold:
+
+      - the measured warm ms/row pair (in-process ledger, seeded from the
+        ``.vm_cache`` plan's persisted measurements on first consult)
+        says fused beats the interpreter for this program, AND
+      - with a ``shape_sig`` (``(batch_shape, sharded)`` — what
+        ``vm.execute`` passes), the fused artifact is ALREADY COMPILED
+        in-process for that signature.
+
+    The shape condition is what keeps ``auto`` from ever paying the
+    cold trace+compile bill (minutes per shape on CPU, ~0.1 s/level even
+    on a warm persistent cache) in the middle of a serving call or a
+    test: the bill is only ever paid by an explicit ``warm_fused``, a
+    pinned-``fused`` call, or the vmexec bench — after which auto serves
+    the compiled shapes and the interpreter keeps everything else. With
+    no fused measurement at all, auto stays on the interpreter."""
+    if mode is None:
+        mode = exec_mode()
+    if mode == "interp":
+        return False
+    if vm._pallas_mode() != "0":
+        return False  # Pallas dispatch keeps the scan path
+    if mode == "fused":
+        return True
+    st = getattr(program, "_exec_stats", None) or {}
+    f, i = st.get("fused_ms_row"), st.get("interp_ms_row")
+    if f is None or i is None:
+        # no in-process pair yet: consult the disk plan once per Program
+        # instance — building the chunk functions is cheap (no XLA
+        # compile) and seeds the ledger from the persisted numbers
+        if not getattr(program, "_fused_plan_checked", False):
+            try:
+                program._fused_plan_checked = True
+            except Exception:
+                pass
+            try:
+                plan = _load_plan(program)
+                meas = (plan.get("measured") or {}) if plan else {}
+                if (meas.get("fused_ms_row") is not None
+                        and meas.get("interp_ms_row") is not None):
+                    fused_program(program, plan=plan)
+            except Exception as e:
+                # a loadable-but-malformed disk plan must not break the
+                # route decision — vm.execute's contract is that lowering
+                # problems never fail a call
+                note_fallback(program, e)
+        st = getattr(program, "_exec_stats", None) or {}
+        f, i = st.get("fused_ms_row"), st.get("interp_ms_row")
+    if f is None or i is None or f >= i:
+        return False
+    if shape_sig is None:
+        return True  # shape-independent query (tests, diagnostics)
+    fp = _FUSED.get(id(program))
+    return fp is not None and tuple(shape_sig) in fp.seen_shapes
+
+
+def run_fused(program, stacked_u32, mesh=None) -> Tuple[jnp.ndarray, bool]:
+    """Execute through the fused lowering. Returns (outputs (batch, n_out,
+    L) u64 array, compile_inclusive) — the flag marks a first execution at
+    this (batch shape, sharded) signature, whose wall time includes
+    trace+XLA-compile and must not enter the warm ms/row ledger."""
+    fp = fused_program(program)
+    sig = (tuple(np.shape(stacked_u32)[:-2]), mesh is not None)
+    compile_inclusive = sig not in fp.seen_shapes
+    out = fp.run(stacked_u32, mesh=mesh)
+    out.block_until_ready()
+    fp.seen_shapes.add(sig)
+    _COUNTERS["executions"] += 1
+    _export_gauges()
+    return out, compile_inclusive
+
+
+def warm_fused(program, batch_shape=()) -> float:
+    """Pre-compile the fused lowering for one unsharded batch shape
+    (sequential AOT across chunks — see ``FusedProgram.warm``) and
+    return the trace+compile wall seconds (0.0 when already compiled
+    in-process; trace+lowering only when a previous process compiled the
+    same shapes into the persistent cache). The vmexec bench reports
+    this number next to each warm ms/row cell; ``auto`` serves fused for
+    a shape only after a call like this has compiled it."""
+    fp = fused_program(program)
+    dt = fp.warm(tuple(int(d) for d in batch_shape))
+    fp.seen_shapes.add((tuple(int(d) for d in batch_shape), False))
+    return dt
+
+
+def note_execution(program, path: str, seconds: float, rows: int,
+                   compile_inclusive: bool = False) -> None:
+    """Feed the per-program measured-ms/row ledger the ``auto`` route
+    reads: the process-lifetime MIN per path (cold compiles converge to
+    the warm number; fused first-shape calls are excluded outright). A
+    meaningful improvement is also persisted into the program's disk plan
+    — that is the artifact a FRESH process's ``auto`` route consults."""
+    if compile_inclusive:
+        return
+    try:
+        st = getattr(program, "_exec_stats", None)
+        if st is None:
+            st = {}
+            program._exec_stats = st
+        key = f"{path}_ms_row"
+        ms = seconds * 1e3 / max(1, rows)
+        prev = st.get(key)
+        if prev is not None and ms >= prev:
+            return
+        st[key] = ms
+        # persist only when the fused artifact is live (its plan is the
+        # carrier) and the number moved enough to matter — disk writes at
+        # device-call scale, never hot-loop scale
+        fp = _FUSED.get(id(program))
+        if fp is not None and (prev is None or ms < prev * 0.9):
+            meas = dict(fp.plan.get("measured") or {})
+            meas[key] = round(ms, 4)
+            for other in ("interp_ms_row", "fused_ms_row"):
+                if other != key and st.get(other) is not None:
+                    cur = meas.get(other)
+                    if cur is None or st[other] < cur:
+                        meas[other] = round(st[other], 4)
+            fp.plan["measured"] = meas
+            _store_plan(program, fp.plan)
+    except Exception:
+        pass  # the ledger is routing advice, never a failure source
+
+
+def note_fallback(program, err: BaseException) -> None:
+    """A fused attempt failed: count it, journal it, let the interpreter
+    serve the call (the caller falls through)."""
+    _COUNTERS["fallbacks"] += 1
+    _export_gauges()
+    try:
+        from ..obs import flight
+
+        flight.note(
+            "vm", "fused_fallback",
+            steps=int(program.n_steps),
+            error=f"{type(err).__name__}: {err}"[:200],
+        )
+    except Exception:
+        pass
+
+
+def reset_fused_state() -> None:
+    """Test hook: drop compiled artifacts and counters (gauges re-zeroed)."""
+    _FUSED.clear()
+    for k in _COUNTERS:
+        _COUNTERS[k] = 0
+    _export_gauges()

@@ -1,0 +1,167 @@
+"""Execution-backend identity canary (`make vmexec-smoke`, CI).
+
+Holds the fused straight-line lowering (ops/vm_compile.py) to BIT-
+identity against the scan interpreter AND to full-coefficient identity
+against the exact-int IR oracle (``vm_analysis.eval_ir`` — the same
+Montgomery-domain integer map, evaluated with Python ints over the
+pre-assembly IR) on registry programs fed random field inputs, on the
+batch axis (VMEXEC_SMOKE_ROWS, default 3):
+
+  - interpreter outputs == fused outputs, every named output, every limb
+    (the contract ``bls_backend._program`` routing relies on);
+  - both == the exact-int oracle's loose Montgomery representatives
+    (stronger than mod-p agreement: it pins the representative every
+    downstream consumer — combine feeds, ``inp(bound=)`` chains —
+    actually receives).
+
+Program set: the DEFAULT subset covers the cheapest registry programs
+one per structural class (a subgroup ladder, an RLC combine, a Miller
+product) — the fused XLA compile bill is ~0.4 s per scheduled level on
+CPU, so the full registry (~15k levels) is opt-in via VMEXEC_SMOKE_FULL=1
+(the @slow pytest tier runs the same module; `make citest` passes
+without the full sweep). The flight recorder is armed; on failure the
+journal (``vm/fused_compile``/``vm/fused_fallback`` events included)
+dumps to ``vmexec_flight.jsonl`` — uploaded as a CI artifact, mirror of
+finalexp-smoke. Exit 0 on pass; nonzero with a diagnosis line otherwise.
+Kept out of tier-1: it pays real fused XLA compiles (tests/
+test_vm_compile.py covers the lowering at synthetic-program scale there).
+"""
+import os
+import random
+import sys
+
+SEED = int(os.environ.get("VMEXEC_SMOKE_SEED", "13"))
+
+# cheapest-per-class default: one fixed-formula ladder (955 levels) and
+# one k-sized Miller program (1333 levels) — ~0.4 s/level of one-time
+# XLA compile bounds the cold-cache CI job to ~15 min. VMEXEC_SMOKE_FULL=1
+# sweeps every BUILDERS kind instead (hard parts + the 3573-level
+# rlc_combine included — an hour-plus of XLA compile on a cold cache).
+DEFAULT_SET = (
+    ("g2_subgroup", 0, 1),
+    ("miller_product", 1, 1),
+)
+
+
+def _full_set():
+    from . import vmlib
+
+    out = []
+    for kind in sorted(vmlib.BUILDERS):
+        k = 2 if kind in ("miller_product", "aggregate_verify",
+                          "rlc_combine") else 0
+        out.append((kind, k, 1))
+    return tuple(out)
+
+
+def main() -> int:
+    # arm the flight recorder for THIS run only — the @slow pytest wrapper
+    # runs main() in-process, and a leaked FLIGHT=1 would re-arm every
+    # later test in the session
+    prev_flight = {
+        k: os.environ.get(k)
+        for k in ("CONSENSUS_SPECS_TPU_FLIGHT",
+                  "CONSENSUS_SPECS_TPU_FLIGHT_DUMP")
+    }
+    os.environ["CONSENSUS_SPECS_TPU_FLIGHT"] = "1"
+    os.environ.setdefault("CONSENSUS_SPECS_TPU_FLIGHT_DUMP",
+                          "vmexec_flight.jsonl")
+    from ..utils.jax_env import force_cpu
+
+    force_cpu()
+
+    import numpy as np
+
+    from ..obs import flight
+    from ..utils import bls12_381 as O
+    from . import bls_backend as bb, fq, vm, vm_analysis, vmlib
+
+    rng = random.Random(SEED)
+    cases = (_full_set() if os.environ.get("VMEXEC_SMOKE_FULL") == "1"
+             else DEFAULT_SET)
+    # one batch shape by default: every row count is a fresh set of XLA
+    # chunk compiles (scalar + multi-row coverage lives in the tier-1
+    # tests at synthetic scale); VMEXEC_SMOKE_ROWS widens it
+    rows_list = tuple(
+        int(x) for x in os.environ.get("VMEXEC_SMOKE_ROWS", "3").split(",")
+        if x)
+    failures = []
+    prev_exec = os.environ.get("CONSENSUS_SPECS_TPU_VM_EXEC")
+
+    try:
+        for kind, k, fold in cases:
+            prog = vmlib.BUILDERS[kind](k, fold)
+            assembled = prog.assemble(
+                w_mul=bb.W_MUL, w_lin=bb.W_LIN,
+                pad_steps_to=bb.PAD_STEPS, pad_regs_to=bb._pow2(64),
+                annotate=True)
+            label = f"{kind}[k={k},fold={fold}]"
+            print(f"vmexec-smoke: {label} steps={assembled.n_steps} "
+                  f"regs={assembled.n_regs}", flush=True)
+            for rows in rows_list:
+                ins_ints = [
+                    {name: rng.randrange(O.P)
+                     for name in assembled.input_names}
+                    for _ in range(rows)
+                ]
+                ins = {
+                    name: np.stack([fq.to_mont_int(row[name])
+                                    for row in ins_ints])
+                    for name in assembled.input_names
+                }
+                os.environ["CONSENSUS_SPECS_TPU_VM_EXEC"] = "interp"
+                out_i = vm.execute(assembled, ins, batch_shape=(rows,))
+                os.environ["CONSENSUS_SPECS_TPU_VM_EXEC"] = "fused"
+                out_f = vm.execute(assembled, ins, batch_shape=(rows,))
+                for name in out_i:
+                    if not np.array_equal(np.asarray(out_i[name]),
+                                          np.asarray(out_f[name])):
+                        failures.append(
+                            f"{label} rows={rows}: fused != interp on "
+                            f"output {name!r}")
+                        break
+                # exact-int oracle, row by row (full limb identity on the
+                # loose Montgomery representative)
+                for r in range(rows):
+                    want = vm_analysis.eval_ir(prog, ins_ints[r])
+                    for name, w in want.items():
+                        got_i = fq.limbs_to_int(
+                            np.asarray(out_i[name])[r])
+                        got_f = fq.limbs_to_int(
+                            np.asarray(out_f[name])[r])
+                        if got_i != w or got_f != w:
+                            failures.append(
+                                f"{label} rows={rows} row={r} output "
+                                f"{name!r}: oracle={w} interp={got_i} "
+                                f"fused={got_f}")
+                            break
+    except Exception as e:
+        failures.append(f"crashed: {type(e).__name__}: {e}")
+    finally:
+        if prev_exec is None:
+            os.environ.pop("CONSENSUS_SPECS_TPU_VM_EXEC", None)
+        else:
+            os.environ["CONSENSUS_SPECS_TPU_VM_EXEC"] = prev_exec
+
+    if failures:
+        for f in failures:
+            print(f"vmexec-smoke FAIL: {f}")
+        rec = flight.global_recorder()
+        if rec is not None:
+            path = rec.dump(reason="vmexec_smoke_failure")
+            if path:
+                print(f"vmexec-smoke: flight journal dumped to {path}")
+    for k, v in prev_flight.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    if failures:
+        return 1
+    print(f"vmexec-smoke: OK — {len(cases)} program(s) x rows {rows_list} "
+          "fused == interp == exact-int oracle")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -673,3 +673,95 @@ def test_latency_extract_shapes(bc):
     }
     assert bc.extract_latency({"parsed": {"error": "boom"}}) == {}
     assert bc.extract_latency({"parsed": _parsed(300.0)}) == {}
+
+
+# -- the vmexec execution-backend race gate (ISSUE 13) ----------------------
+
+
+def _vx_parsed(value, cells, **extra):
+    """A --mode vmexec round: cells maps "kind,rows" ->
+    (ok, fused_ms_row, interp_ms_row)."""
+    section = {
+        name: {"ok": ok, "fused_ms_row": fused, "interp_ms_row": interp,
+               "fused_compile_s": 1.0,
+               "speedup": round(interp / fused, 2) if fused else None}
+        for name, (ok, fused, interp) in cells.items()
+    }
+    return _parsed(value, mode="vmexec", n=None, k=None,
+                   vmexec=section, **extra)
+
+
+def test_vmexec_newly_erroring_cell_fails(tmp_path, bc, capsys):
+    """A (kind, rows) cell whose fused lowering ran AND matched the
+    interpreter bitwise last round and errors (or mismatches) now fails
+    outright — losing the fused backend on a program kind is a
+    correctness/availability regression (mirror of FINALEXP ERRORED)."""
+    _write_round(tmp_path, 1, _vx_parsed(
+        5.5, {"g2_subgroup,1": (True, 46.3, 255.0),
+              "hard_part_frobenius,8": (True, 35.0, 113.0)}))
+    _write_round(tmp_path, 2, _vx_parsed(
+        5.5, {"g2_subgroup,1": (True, 46.3, 255.0),
+              "hard_part_frobenius,8": (False, 0.0, 113.0)}))
+    assert bc.main(["--dir", str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "cpu:vmexec:hard_part_frobenius,8" in out
+    assert "VMEXEC ERRORED" in out
+
+
+def test_vmexec_ms_row_is_report_only(tmp_path, bc, capsys):
+    """Fused/interp ms-row movement — even the fused path losing to the
+    interpreter — never fails on its own: the auto route re-measures per
+    machine, and CPU numbers jitter; the page-worthy event is a cell
+    STOPPING (error or bitwise mismatch), not slowing."""
+    _write_round(tmp_path, 1, _vx_parsed(
+        5.5, {"g2_subgroup,1": (True, 46.3, 255.0)}))
+    _write_round(tmp_path, 2, _vx_parsed(
+        5.5, {"g2_subgroup,1": (True, 400.0, 255.0)}))
+    assert bc.main(["--dir", str(tmp_path)]) == 0
+    assert "cpu:vmexec:g2_subgroup,1" in capsys.readouterr().out
+
+
+def test_vmexec_still_erroring_is_not_a_new_failure(tmp_path, bc):
+    _write_round(tmp_path, 1, _vx_parsed(
+        5.5, {"h2g_finish,8": (False, 0.0, 90.0)}))
+    _write_round(tmp_path, 2, _vx_parsed(
+        5.5, {"h2g_finish,8": (False, 0.0, 90.0)}))
+    assert bc.main(["--dir", str(tmp_path)]) == 0
+
+
+def test_vmexec_keys_join_without_common_throughput_keys(tmp_path, bc,
+                                                         capsys):
+    """Shared vmexec cells are comparables in their own right (the
+    SLO/sim/mesh/finalexp rule): disjoint throughput shapes must still
+    gate an ok -> error transition instead of skipping."""
+    _write_round(tmp_path, 1, _parsed(
+        1000.0, mode="head", n=None, k=None, blocks=1024,
+        vmexec={"g2_subgroup,1": {"ok": True, "fused_ms_row": 46.3,
+                                  "interp_ms_row": 255.0}}))
+    _write_round(tmp_path, 2, _parsed(
+        900.0, mode="head", n=None, k=None, blocks=128,
+        vmexec={"g2_subgroup,1": {"ok": False, "fused_ms_row": 0.0,
+                                  "interp_ms_row": 255.0}}))
+    assert bc.main(["--dir", str(tmp_path)]) == 1
+    assert "VMEXEC ERRORED" in capsys.readouterr().out
+
+
+def test_vmexec_new_cells_are_not_gated_until_seen(tmp_path, bc):
+    """A cell appearing for the first time (no previous-round entry) is
+    report-only — new kinds join the gate once they have a baseline."""
+    _write_round(tmp_path, 1, _vx_parsed(
+        5.5, {"g2_subgroup,1": (True, 46.3, 255.0)}))
+    _write_round(tmp_path, 2, _vx_parsed(
+        5.5, {"g2_subgroup,1": (True, 46.3, 255.0),
+              "rlc_combine,8": (False, 0.0, 500.0)}))
+    assert bc.main(["--dir", str(tmp_path)]) == 0
+
+
+def test_vmexec_extract_shapes(bc):
+    doc = {"parsed": _vx_parsed(
+        5.5, {"g2_subgroup,1": (True, 46.3, 255.0)})}
+    got = bc.extract_vmexec(doc)
+    assert got == {"cpu:vmexec:g2_subgroup,1": {
+        "ok": True, "fused_ms_row": 46.3, "interp_ms_row": 255.0}}
+    assert bc.extract_vmexec({"parsed": {"error": "boom"}}) == {}
+    assert bc.extract_vmexec({"parsed": _parsed(1.0)}) == {}

@@ -403,3 +403,48 @@ def test_serve_single_device_mesh_collapses_to_unsharded():
         svc.close(timeout=30)
     assert svc.mesh_devices == 0
     assert be.mesh_calls == 0 and be.plain_calls >= 1
+
+
+# -- fused lowering under the mesh batch axis (ISSUE 13) --------------------
+
+
+def test_fused_execution_identity_under_mesh(monkeypatch):
+    """The fused straight-line backend must ride `vm.execute(mesh=)`
+    bit-identically to the interpreter: the chunk graphs are purely
+    batch-elementwise, so GSPMD shards the carry over the mesh axes with
+    zero collectives — the contract that lets PR 9's sharded Miller
+    loops and PR 10's batcher take either backend unchanged."""
+    import random
+
+    from consensus_specs_tpu.ops import vm, vm_compile
+
+    rng = random.Random(17)
+    prog = vm.Prog()
+    a, b, c = (prog.inp(n) for n in "abc")
+    k = prog.const(12345)
+    acc = (a * b + k) - c
+    for _ in range(4):
+        acc = acc * acc + (b - a)
+    prog.out(acc, "r")
+    assembled = prog.assemble(w_mul=64, w_lin=64, pad_steps_to=256,
+                              pad_regs_to=64)
+    ints = [{n: rng.randrange(O.P) for n in "abc"} for _ in range(4)]
+    ins = {
+        n: np.stack([fq.to_mont_int(row[n]) for row in ints])
+        for n in "abc"
+    }
+    mesh = _mesh(2)
+    vm_compile.reset_fused_state()
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_VM_FUSED_CHUNK", "3")
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_VM_EXEC", "interp")
+    out_i = vm.execute(assembled, ins, batch_shape=(4,), mesh=mesh)
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_VM_EXEC", "fused")
+    out_f = vm.execute(assembled, ins, batch_shape=(4,), mesh=mesh)
+    out_u = vm.execute(assembled, ins, batch_shape=(4,))  # unsharded fused
+    assert vm_compile._COUNTERS["fallbacks"] == 0
+    for name in out_i:
+        assert np.array_equal(np.asarray(out_i[name]),
+                              np.asarray(out_f[name])), name
+        assert np.array_equal(np.asarray(out_i[name]),
+                              np.asarray(out_u[name])), name
+    vm_compile.reset_fused_state()

@@ -1,0 +1,378 @@
+"""Fused straight-line lowering (ops/vm_compile.py, ISSUE 13): identity
+against the interpreter and the exact-int IR oracle, chunk-boundary
+liveness, routing (interp|fused|auto + the measured-winner persistence),
+the interpreter fallback with its flight event, and the fused
+``.vm_cache`` key/prune rules.
+
+Everything here runs at SYNTHETIC-program scale (tens of levels, tiny
+chunk overrides) so the whole module stays in the tier-1 budget — the
+fused XLA compile bill for REGISTRY programs (~0.4 s per scheduled level
+on CPU) lives in `make vmexec-smoke` and the @slow tier instead."""
+import os
+import random
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from consensus_specs_tpu.ops import (  # noqa: E402
+    bls_backend as bb, fq, vm, vm_analysis, vm_compile, vmlib,
+)
+from consensus_specs_tpu.utils import bls12_381 as O  # noqa: E402
+
+rng = random.Random(31)
+
+BUCKET = dict(w_mul=64, w_lin=64, pad_steps_to=256, pad_regs_to=64)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_fused_state():
+    vm_compile.reset_fused_state()
+    yield
+    vm_compile.reset_fused_state()
+
+
+def _mixed_prog(depth=6):
+    """A synthetic program exercising every op kind, constants, input
+    reuse, and enough depth to span several tiny chunks."""
+    prog = vm.Prog()
+    a = prog.inp("a")
+    b = prog.inp("b")
+    c = prog.inp("c")
+    k = prog.const(0x1234567890ABCDEF ^ O.P // 3)
+    acc = a * b + k
+    other = (b - c) * (a + k)
+    for _ in range(depth):
+        acc = acc * acc + other
+        other = other * b - a
+    prog.out(acc, "acc")
+    prog.out(other, "other")
+    return prog
+
+
+def _rand_inputs(prog, rows=0):
+    names = set()
+    ints = [
+        {n: rng.randrange(O.P) for n in prog.input_names}
+        for _ in range(max(1, rows))
+    ]
+    if rows:
+        arrs = {
+            n: np.stack([fq.to_mont_int(row[n]) for row in ints])
+            for n in ints[0]
+        }
+    else:
+        arrs = {n: fq.to_mont_int(v) for n, v in ints[0].items()}
+    return ints, arrs
+
+
+def _run_both(assembled, arrs, batch_shape, monkeypatch):
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_VM_EXEC", "interp")
+    out_i = vm.execute(assembled, arrs, batch_shape=batch_shape)
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_VM_EXEC", "fused")
+    out_f = vm.execute(assembled, arrs, batch_shape=batch_shape)
+    return out_i, out_f
+
+
+def test_fused_identity_and_oracle_scalar(monkeypatch):
+    prog = _mixed_prog()
+    assembled = prog.assemble(**BUCKET)
+    ints, arrs = _rand_inputs(prog)
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_VM_FUSED_CHUNK", "5")
+    out_i, out_f = _run_both(assembled, arrs, (), monkeypatch)
+    want = vm_analysis.eval_ir(prog, ints[0])
+    for name in out_i:
+        assert np.array_equal(np.asarray(out_i[name]),
+                              np.asarray(out_f[name])), name
+        got = fq.limbs_to_int(np.asarray(out_f[name]))
+        # full loose-representative identity, not just mod-p agreement
+        assert got == want[name], name
+    assert vm_compile._COUNTERS["executions"] == 1
+    assert vm_compile._COUNTERS["fallbacks"] == 0
+
+
+def test_fused_identity_batch_axis(monkeypatch):
+    prog = _mixed_prog(depth=4)
+    assembled = prog.assemble(**BUCKET)
+    ints, arrs = _rand_inputs(prog, rows=3)
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_VM_FUSED_CHUNK", "4")
+    out_i, out_f = _run_both(assembled, arrs, (3,), monkeypatch)
+    for name in out_i:
+        assert np.array_equal(np.asarray(out_i[name]),
+                              np.asarray(out_f[name])), name
+    for r in range(3):
+        want = vm_analysis.eval_ir(prog, ints[r])
+        for name, w in want.items():
+            assert fq.limbs_to_int(np.asarray(out_f[name])[r]) == w
+
+
+@pytest.mark.parametrize("chunk", ["1", "3", "1000000"])
+def test_chunk_boundary_liveness(monkeypatch, chunk):
+    """Identity must hold at EVERY chunking — chunk=1 puts a carry
+    boundary after every level (maximum live-set stress), the huge value
+    collapses to a single chunk (no boundaries at all)."""
+    prog = _mixed_prog(depth=3)
+    assembled = prog.assemble(**BUCKET)
+    ints, arrs = _rand_inputs(prog)
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_VM_FUSED_CHUNK", chunk)
+    out_i, out_f = _run_both(assembled, arrs, (), monkeypatch)
+    for name in out_i:
+        assert np.array_equal(np.asarray(out_i[name]),
+                              np.asarray(out_f[name])), name
+
+
+def test_fused_f12_formula_vs_oracle(monkeypatch):
+    """A real vmlib formula block (Fq12 mul) through the fused backend,
+    held to the pure-Python field oracle — the same contract
+    tests/test_vm.py pins on the interpreter."""
+    prog = vm.Prog()
+    x = [prog.inp(f"x{i}") for i in range(12)]
+    y = [prog.inp(f"y{i}") for i in range(12)]
+    m = vmlib.f12_mul(prog, x, y)
+    for i, c in enumerate(m):
+        prog.out(c, f"m{i}")
+    assembled = prog.assemble(**BUCKET)
+    ints, arrs = _rand_inputs(prog)
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_VM_FUSED_CHUNK", "8")
+    out_i, out_f = _run_both(assembled, arrs, (), monkeypatch)
+    for name in out_i:
+        assert np.array_equal(np.asarray(out_i[name]),
+                              np.asarray(out_f[name])), name
+    want = vm_analysis.eval_ir(prog, ints[0])
+    for name, w in want.items():
+        assert fq.limbs_to_int(np.asarray(out_f[name])) == w
+
+
+def test_fused_fallback_flight_event(monkeypatch):
+    """A fused trace/compile/run failure must fall back to the
+    interpreter (correct outputs, no exception) and journal a
+    vm/fused_fallback flight event."""
+    from consensus_specs_tpu.obs import flight
+
+    prog = _mixed_prog(depth=2)
+    assembled = prog.assemble(**BUCKET)
+    ints, arrs = _rand_inputs(prog)
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_VM_EXEC", "fused")
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_FLIGHT", "1")
+    flight.reset_global()
+
+    def boom(*a, **kw):
+        raise RuntimeError("injected lowering failure")
+
+    monkeypatch.setattr(vm_compile, "run_fused", boom)
+    out = vm.execute(assembled, arrs)
+    want = vm_analysis.eval_ir(prog, ints[0])
+    for name, w in want.items():
+        assert fq.limbs_to_int(np.asarray(out[name])) == w
+    assert vm_compile._COUNTERS["fallbacks"] == 1
+    events = [e for e in flight.global_recorder().events()
+              if e.get("plane") == "vm" and e.get("kind") == "fused_fallback"]
+    assert events, "fused_fallback flight event missing"
+    assert "injected lowering failure" in events[-1]["data"]["error"]
+    flight.reset_global()
+
+
+def test_auto_routing_uses_measured_winner(monkeypatch):
+    """auto == interp until a fused measurement exists; once the ledger
+    holds both warm numbers the measured winner takes the call."""
+    prog = _mixed_prog(depth=2)
+    assembled = prog.assemble(**BUCKET)
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_VM_EXEC", "auto")
+    assert not vm_compile.use_fused(assembled)  # no measurements: interp
+    assembled._exec_stats = {"fused_ms_row": 1.0, "interp_ms_row": 5.0}
+    assert vm_compile.use_fused(assembled)
+    assembled._exec_stats = {"fused_ms_row": 5.0, "interp_ms_row": 1.0}
+    assert not vm_compile.use_fused(assembled)
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_VM_EXEC", "interp")
+    assembled._exec_stats = {"fused_ms_row": 1.0, "interp_ms_row": 5.0}
+    assert not vm_compile.use_fused(assembled)  # pinned interp always wins
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_VM_EXEC", "fused")
+    assembled._exec_stats = {}
+    assert vm_compile.use_fused(assembled)  # pinned fused compiles on demand
+
+
+def test_auto_routing_persists_across_processes(monkeypatch, tmp_path):
+    """The measured-winner pair rides the .vm_cache lowering plan: a
+    fresh Program instance (== fresh process) with the same fused cache
+    key adopts the persisted verdict — but auto only SERVES fused once
+    the shape is compiled (warm_fused/pinned-fused), never paying the
+    cold compile bill mid-call."""
+    monkeypatch.setattr(bb, "_vm_cache_dir", lambda: str(tmp_path))
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_VM_FUSED_CHUNK", "6")
+    prog = _mixed_prog(depth=2)
+    assembled = prog.assemble(**BUCKET)
+    assembled.meta["fused_key"] = ("synthetic", 0, 1, "cafe0123")
+    ints, arrs = _rand_inputs(prog)
+
+    # measure both paths in "process one" (interp first, then fused twice
+    # so the second, warm call lands in the ledger and persists)
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_VM_EXEC", "interp")
+    vm.execute(assembled, arrs)
+    vm.execute(assembled, arrs)
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_VM_EXEC", "fused")
+    vm.execute(assembled, arrs)
+    vm.execute(assembled, arrs)
+    st = assembled._exec_stats
+    assert st.get("fused_ms_row") is not None
+    assert st.get("interp_ms_row") is not None
+
+    plan_path = vm_compile._plan_cache_path(assembled)
+    assert plan_path is not None and os.path.exists(plan_path)
+    import pickle
+
+    with open(plan_path, "rb") as fh:
+        meas = pickle.load(fh).get("measured") or {}
+    assert "fused_ms_row" in meas and "interp_ms_row" in meas
+
+    # force the persisted pair to a known winner, then simulate a fresh
+    # process: a new Program object with the same cache identity
+    with open(plan_path, "rb") as fh:
+        plan = pickle.load(fh)
+    plan["measured"] = {"fused_ms_row": 1.0, "interp_ms_row": 9.0}
+    with open(plan_path, "wb") as fh:
+        pickle.dump(plan, fh)
+    vm_compile.reset_fused_state()
+    fresh = prog.assemble(**BUCKET)
+    fresh.meta["fused_key"] = ("synthetic", 0, 1, "cafe0123")
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_VM_EXEC", "auto")
+    assert vm_compile.use_fused(fresh)  # winner adopted off the disk plan
+    # ...but a not-yet-compiled shape must stay on the interpreter: auto
+    # never pays the cold trace+compile bill inside a call
+    assert not vm_compile.use_fused(fresh, shape_sig=((), False))
+    before = vm_compile._COUNTERS["executions"]
+    out_cold = vm.execute(fresh, arrs)
+    assert vm_compile._COUNTERS["executions"] == before  # interp served it
+    vm_compile.warm_fused(fresh, ())
+    assert vm_compile.use_fused(fresh, shape_sig=((), False))
+    out_a = vm.execute(fresh, arrs)
+    assert vm_compile._COUNTERS["executions"] == before + 1  # fused now
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_VM_EXEC", "interp")
+    out_i = vm.execute(fresh, arrs)
+    for name in out_i:
+        assert np.array_equal(np.asarray(out_a[name]),
+                              np.asarray(out_i[name])), name
+        assert np.array_equal(np.asarray(out_cold[name]),
+                              np.asarray(out_i[name])), name
+
+    # a persisted interp win keeps auto on the interpreter
+    plan["measured"] = {"fused_ms_row": 9.0, "interp_ms_row": 1.0}
+    with open(plan_path, "wb") as fh:
+        pickle.dump(plan, fh)
+    vm_compile.reset_fused_state()
+    fresh2 = prog.assemble(**BUCKET)
+    fresh2.meta["fused_key"] = ("synthetic", 0, 1, "cafe0123")
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_VM_EXEC", "auto")
+    assert not vm_compile.use_fused(fresh2)
+
+
+def test_warm_fused_reports_compile_seconds(monkeypatch):
+    prog = _mixed_prog(depth=2)
+    assembled = prog.assemble(**BUCKET)
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_VM_FUSED_CHUNK", "6")
+    dt = vm_compile.warm_fused(assembled, ())
+    assert dt > 0.0
+    assert vm_compile.warm_fused(assembled, ()) == 0.0  # in-process warm
+
+
+# -- fused .vm_cache key + prune rules (ISSUE 13 satellite) ----------------
+
+
+def _fused_name(lowering=None, version=None, kind="g2_subgroup", fp=None):
+    lowering = vm_compile.LOWERING_VERSION if lowering is None else lowering
+    version = bb._VM_CACHE_VERSION if version is None else version
+    fp = bb._program_fingerprint(kind) if fp is None else fp
+    return (f"fused_l{lowering}_v{version}_{fp}_{kind}"
+            f"_k0_f1_w96x192_p1024_c24.pkl")
+
+
+def test_fused_cache_stale_rules():
+    assert not bb._vm_cache_entry_stale(_fused_name())
+    # a lowering bump evicts fused plans WITHOUT touching interp tensors
+    assert bb._vm_cache_entry_stale(
+        _fused_name(lowering=vm_compile.LOWERING_VERSION + 1))
+    assert bb._vm_cache_entry_stale(
+        _fused_name(version=bb._VM_CACHE_VERSION + 1))
+    # a moved per-program fingerprint (edited builder) evicts too
+    assert bb._vm_cache_entry_stale(_fused_name(fp="00000000"))
+    # unknown kinds are kept (age/size still bound them)
+    assert not bb._vm_cache_entry_stale(
+        _fused_name(kind="not_a_builder", fp="00000000"))
+    # malformed fused names are kept, never crash
+    assert not bb._vm_cache_entry_stale("fused_weird.pkl")
+
+
+def test_prune_evicts_stale_fused_entries(tmp_path):
+    stale = tmp_path / _fused_name(lowering=vm_compile.LOWERING_VERSION + 1)
+    fresh = tmp_path / _fused_name()
+    interp = tmp_path / (
+        f"v{bb._VM_CACHE_VERSION}_{bb._program_fingerprint('g2_subgroup')}"
+        "_g2_subgroup_k0_f1_w96x192_p1024.pkl")
+    for p in (stale, fresh, interp):
+        p.write_bytes(b"x" * 64)
+    res = bb.prune_vm_cache(max_age_days=0, max_bytes=0,
+                            cache_dir=str(tmp_path))
+    assert not stale.exists()  # old lowering version: gone immediately
+    assert fresh.exists()      # current fused artifact: kept
+    assert interp.exists()     # interp tensors: untouched by the bump
+    assert res["evicted"] == 1 and res["kept"] == 2
+
+
+def test_fused_key_rides_program_cache(tmp_path, monkeypatch):
+    """bls_backend._program stamps the fused cache identity onto the
+    assembled (and disk-cached) program's meta so the lowering can disk-
+    key its plan; the stamp survives the pickle round-trip."""
+    monkeypatch.setattr(bb, "_vm_cache_dir", lambda: str(tmp_path))
+    bb._program.cache_clear()
+    try:
+        prog, fold = bb._program("g2_subgroup", 0, 1)
+        key = prog.meta.get("fused_key")
+        assert key is not None
+        kind, k, f, fp = key
+        assert (kind, k, f) == ("g2_subgroup", 0, 1)
+        assert fp == bb._program_fingerprint("g2_subgroup")
+        bb._program.cache_clear()
+        again, _ = bb._program("g2_subgroup", 0, 1)  # disk hit this time
+        assert again.meta.get("fused_key") == key
+    finally:
+        bb._program.cache_clear()
+
+
+# -- `make native` discoverability warning (ISSUE 13 satellite) ------------
+
+
+def test_assemble_warns_once_when_native_kernel_missing(monkeypatch, capsys):
+    monkeypatch.setattr(vm, "_NATIVE_SCHED", None)
+    monkeypatch.setattr(vm, "_NATIVE_WARNED", False)
+    prog = _mixed_prog(depth=1)
+    prog.assemble(**BUCKET)
+    err = capsys.readouterr().err
+    assert "make native" in err and "libvmsched" in err
+    prog2 = _mixed_prog(depth=1)
+    prog2.assemble(**BUCKET)
+    assert "make native" not in capsys.readouterr().err  # once per process
+
+
+def test_no_warning_when_native_kernel_present(monkeypatch, capsys):
+    # _warn_native_missing only prints when the kernel is absent; with a
+    # (real or stand-in) kernel loaded it stays silent
+    monkeypatch.setattr(vm, "_NATIVE_SCHED", object())
+    monkeypatch.setattr(vm, "_NATIVE_WARNED", False)
+    vm._warn_native_missing()
+    assert "make native" not in capsys.readouterr().err
+    assert vm._NATIVE_WARNED is False
+
+
+# -- full-registry identity (out of tier-1) --------------------------------
+
+
+@pytest.mark.slow
+def test_vmexec_smoke_full_registry(monkeypatch):
+    """The `make vmexec-smoke` module over the ENTIRE BUILDERS registry
+    (production shapes): fused == interp == exact-int oracle. Pays one
+    fused XLA compile per program — minutes-to-hours on a cold persistent
+    cache, so @slow (the CI job runs the module's default cheap subset)."""
+    from consensus_specs_tpu.ops import vmexec_smoke
+
+    monkeypatch.setenv("VMEXEC_SMOKE_FULL", "1")
+    assert vmexec_smoke.main() == 0

@@ -60,6 +60,13 @@ correctness/availability regression), while ms/row movement — including
 a previously-winning device route going slower than host — is
 report-only.
 
+Vmexec gating: rounds that carry a ``vmexec`` section (`bench.py --mode
+vmexec` — per-(kind, rows) interpreter-vs-fused execution race cells)
+gate on the same state rule: a cell whose fused lowering ran AND matched
+the interpreter bit for bit in the previous round and errors (or
+mismatches) in the newest fails the round outright ("VMEXEC ERRORED",
+mirror of FINALEXP ERRORED); the ms/row numbers are report-only.
+
 Latency gating: rounds that carry a ``latency`` section (`bench.py
 --mode latency` — per-scenario gossip→head rows under the adversarial
 simnet runs) gate on the same state rule: a scenario whose deadline-mode
@@ -269,6 +276,37 @@ def extract_latency(doc):
     return out
 
 
+def extract_vmexec(doc):
+    """{``platform:vmexec:<kind,rows>``: {"ok", "fused_ms_row",
+    "interp_ms_row"}} from one round's ``vmexec`` section (`bench.py
+    --mode vmexec` interpreter-vs-fused execution race cells)."""
+    parsed = doc.get("parsed")
+    if not isinstance(parsed, dict) or "error" in parsed:
+        return {}
+    section = parsed.get("vmexec")
+    if not isinstance(section, dict):
+        return {}
+    plat = _platform(parsed)
+    out = {}
+    for name, row in sorted(section.items()):
+        if not isinstance(row, dict) or "ok" not in row:
+            continue
+        try:
+            fused = float(row.get("fused_ms_row") or 0.0)
+        except (TypeError, ValueError):
+            fused = 0.0
+        try:
+            interp = float(row.get("interp_ms_row") or 0.0)
+        except (TypeError, ValueError):
+            interp = 0.0
+        out[f"{plat}:vmexec:{name}"] = {
+            "ok": bool(row.get("ok", False)),
+            "fused_ms_row": fused,
+            "interp_ms_row": interp,
+        }
+    return out
+
+
 def extract_finalexp(doc):
     """{``platform:finalexp:<variant,rows>``: {"ok", "ms_per_row"}} from
     one round's ``finalexp`` section (`bench.py --mode finalexp` hard-part
@@ -351,6 +389,7 @@ def main(argv=None) -> int:
         new_sim = extract_sim(newest_doc)
         new_mesh = extract_mesh(newest_doc)
         new_fx = extract_finalexp(newest_doc)
+        new_vx = extract_vmexec(newest_doc)
         new_fleet = extract_fleet(newest_doc)
         new_lat = extract_latency(newest_doc)
     except (OSError, ValueError) as e:
@@ -367,7 +406,7 @@ def main(argv=None) -> int:
         return 0
 
     prev_vals, prev_slo, prev_sim, prev_mesh = {}, {}, {}, {}
-    prev_fx, prev_fleet, prev_lat, prev_path = {}, {}, {}, None
+    prev_fx, prev_vx, prev_fleet, prev_lat, prev_path = {}, {}, {}, {}, None
     for path in reversed(files[:-1]):
         try:
             doc = _load(path)
@@ -376,20 +415,22 @@ def main(argv=None) -> int:
             prev_sim = extract_sim(doc)
             prev_mesh = extract_mesh(doc)
             prev_fx = extract_finalexp(doc)
+            prev_vx = extract_vmexec(doc)
             prev_fleet = extract_fleet(doc)
             prev_lat = extract_latency(doc)
         except (OSError, ValueError):
             prev_vals, prev_slo, prev_sim = {}, {}, {}
-            prev_mesh, prev_fx, prev_fleet, prev_lat = {}, {}, {}, {}
+            prev_mesh, prev_fx, prev_vx = {}, {}, {}
+            prev_fleet, prev_lat = {}, {}
         # an SLO-only or sim-only round (headline errored, objectives or
         # scenario matrix still recorded) is a usable baseline for its
         # state gate even with no throughput number
         if (prev_vals or prev_slo or prev_sim or prev_mesh or prev_fx
-                or prev_fleet or prev_lat):
+                or prev_vx or prev_fleet or prev_lat):
             prev_path = path
             break
     if not (prev_vals or prev_slo or prev_sim or prev_mesh or prev_fx
-            or prev_fleet or prev_lat):
+            or prev_vx or prev_fleet or prev_lat):
         print("bench-compare: SKIP — no earlier round recorded a usable value")
         return 0
 
@@ -398,11 +439,12 @@ def main(argv=None) -> int:
     sim_common = sorted(set(new_sim) & set(prev_sim))
     mesh_common = sorted(set(new_mesh) & set(prev_mesh))
     fx_common = sorted(set(new_fx) & set(prev_fx))
+    vx_common = sorted(set(new_vx) & set(prev_vx))
     fleet_common = sorted(set(new_fleet) & set(prev_fleet))
     lat_common = sorted(set(new_lat) & set(prev_lat))
     if (not common and not slo_common and not sim_common
-            and not mesh_common and not fx_common and not fleet_common
-            and not lat_common):
+            and not mesh_common and not fx_common and not vx_common
+            and not fleet_common and not lat_common):
         # SLO keys count as comparables too: two rounds that share no
         # throughput shape but both declare serve_p99 must still gate the
         # objective state, not skip past it
@@ -568,6 +610,33 @@ def main(argv=None) -> int:
         if broke:
             failures.append(key)
 
+    # vmexec state gate: an execution-backend race cell that was ok
+    # (fused ran AND matched the interpreter bit for bit) last round and
+    # errors or mismatches now fails outright — "VMEXEC ERRORED", the
+    # finalexp-gate mirror for the lowering plane: losing the fused
+    # backend (or bit-identity) on a program kind is a correctness/
+    # availability regression; the ms/row movement either way is
+    # report-only, exactly like finalexp ms/row
+    for key in vx_common:
+        old, new = prev_vx[key], new_vx[key]
+        broke = old["ok"] and not new["ok"]
+        status = "VMEXEC ERRORED" if broke else (
+            "ok" if new["ok"] else "still erroring")
+        print(
+            f"  {key}: fused {old['fused_ms_row']:.2f} -> "
+            f"{new['fused_ms_row']:.2f} ms/row (interp "
+            f"{new['interp_ms_row']:.2f}; ok: {old['ok']} -> {new['ok']})"
+            f"{'  ' + status if broke else ''}"
+        )
+        rows.append((key, f"{old['fused_ms_row']:.2f}ms",
+                     f"{new['fused_ms_row']:.2f}ms",
+                     (new["fused_ms_row"] - old["fused_ms_row"])
+                     / old["fused_ms_row"]
+                     if old["fused_ms_row"] else None,
+                     status))
+        if broke:
+            failures.append(key)
+
     _emit_markdown(rows, os.path.basename(prev_path),
                    os.path.basename(newest), args.max_regression)
     if failures:
@@ -586,6 +655,8 @@ def main(argv=None) -> int:
            if mesh_common else "")
         + (f", {len(fx_common)} finalexp cell(s) gated"
            if fx_common else "")
+        + (f", {len(vx_common)} vmexec cell(s) gated"
+           if vx_common else "")
         + (f", {len(fleet_common)} fleet worker count(s) gated"
            if fleet_common else "")
         + (f", {len(lat_common)} latency scenario(s) gated"

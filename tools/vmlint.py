@@ -39,12 +39,16 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 def _fmt_line(r: dict) -> str:
     p, c = r["pressure"], r["cost"]
+    # interp prediction: the 280 µs/step register-file model; fused: the
+    # ISSUE 13 straight-line lowering model (real per-level widths +
+    # per-level/per-chunk glue — ops/vm_analysis.py FUSED_COST_*)
     return (
         f"{r['name']:<36} steps={p['sched_steps']:<6} "
         f"crit={c['critical_path']:<6} work={c['work_steps']:<5} "
         f"{c['classification']:<11} live={p['max_live']:<5} "
         f"regs={p['alloc_regs']:<5} mulutil={c['mul_utilization']:<7} "
         f"pred={c['predicted_row_s']:.2f}s/row "
+        f"fused={c['predicted_fused_row_s']:.2f}s/row "
         f"err={r['errors']} warn={r['warnings']}"
     )
 
