@@ -21,7 +21,7 @@ CHEAP_GENERATORS = shuffling bls ssz_generic merkle
         clean_vectors generate_random_tests bench-compare check serve-trace head-bench docs \
         sim-bench sim-smoke serve-bench-mesh mesh-smoke clean rlc-bench \
         finalexp-bench finalexp-smoke native sweep serve-fleet-bench fleet-smoke \
-        latency-bench latency-smoke vmexec-bench vmexec-smoke
+        latency-bench latency-smoke vmexec-bench vmexec-smoke vmexec-cold-smoke
 
 # fast default: BLS stubbed except @always_bls, 4-way process-parallel
 # (reference `make test` = pytest -n 4, reference Makefile:100)
@@ -260,13 +260,31 @@ finalexp-bench:
 # programs — warm ms/row both ways, fused trace/compile seconds, and
 # per-cell bit-identity, keyed `vmexec[kind,rows]`. First run on a
 # machine pays one XLA compile per (kind, rows) cell (persistent-cached
-# after); VMEXEC_KINDS/VMEXEC_ROWS resize. Cells are state-gated round
-# over round by tools/bench_compare.py ("VMEXEC ERRORED" — ms/row is
+# after — with ISSUE 15's structural dedup a cell compiles one XLA
+# executable per DISTINCT chunk structure, not per chunk);
+# VMEXEC_KINDS/VMEXEC_ROWS resize. Cells are state-gated round over
+# round by tools/bench_compare.py ("VMEXEC ERRORED" — ms/row is
 # report-only). Running it also persists each program's measured winner
 # into .vm_cache — the verdict CONSENSUS_SPECS_TPU_VM_EXEC=auto adopts
-# (auto serves fused only for shapes a warm/pinned call has compiled).
+# (auto serves fused only for shapes a warm/pinned/background-warm call
+# has compiled). The cold cells (`cold,<kind>` / `cold_nodedup,<kind>`)
+# spawn fresh child processes against fresh XLA caches and race
+# structural dedup against the PR 13 per-chunk baseline — the
+# `cold_speedup` headline is the ISSUE 15 fresh-process
+# time-to-fused-ready win (VMEXEC_COLD=dedup skips the minutes-scale
+# baseline arm, VMEXEC_COLD=0 skips both).
 vmexec-bench:
 	JAX_PLATFORMS=cpu python bench.py --mode vmexec
+
+# fresh-process fused-ready canary (CI, ISSUE 15): one child process
+# against a brand-new persistent-XLA-cache dir must reach a fused-ready
+# g2_subgroup fold-1 (955-level ladder) with bit-identity — proving a
+# fresh CI runner / fleet worker gets the fast path in seconds-scale
+# time, not the pre-dedup minutes. The VMEXEC_COLD_BUDGET_S budget
+# (default 180 s) is reported here and STATE-gated by bench_compare's
+# cold cells, not hard-asserted (slow public runners must not flake CI).
+vmexec-cold-smoke:
+	JAX_PLATFORMS=cpu python -m consensus_specs_tpu.bench.vmexec_cold --smoke
 
 # execution-backend identity canary (CI, mirror of finalexp-smoke): the
 # fused straight-line lowering held to BIT-identity against the scan

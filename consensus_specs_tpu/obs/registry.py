@@ -134,6 +134,15 @@ GAUGES: Dict[str, str] = {
     "vm.fused_fallbacks": "fused trace/compile/run failures that fell "
                           "back to the interpreter (each journals a "
                           "vm/fused_fallback flight event)",
+    "vm.fused_structs": "distinct canonical chunk structures compiled "
+                        "by the fused backend in this process (shared "
+                        "across chunks, programs, and batch warms — the "
+                        "ISSUE 15 structural-dedup unit)",
+    "vm.fused_struct_hits": "fused compile units served by an "
+                            "already-compiled structure (journals "
+                            "vm/structural_hit)",
+    "vm.fused_struct_misses": "fused compile units that paid a real XLA "
+                              "compile (journals vm/structural_miss)",
     "bls.vm_cache_pruned_entries": "entries `make vm-cache-prune` evicted "
                                    "from .vm_cache/ (last prune in this "
                                    "process)",

@@ -148,7 +148,10 @@ def _fold_for(kind: str, k: int, n_items: int = 1 << 30) -> int:
 
 
 def _vm_cache_dir() -> str:
-    d = os.path.join(
+    # CONSENSUS_SPECS_TPU_VM_CACHE overrides the repo-local default —
+    # the cold-start bench children point it (and the XLA cache) at
+    # fresh temp dirs so BOTH arms measure a genuinely fresh runner
+    d = os.environ.get("CONSENSUS_SPECS_TPU_VM_CACHE") or os.path.join(
         os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
         ".vm_cache",
     )
@@ -258,30 +261,38 @@ def _attach_fused_key(assembled, kind: str, k: int, fold: int) -> None:
 
 
 _VM_CACHE_NAME_RE = None  # compiled lazily (module import stays light)
-_FUSED_CACHE_NAME_RE = None
+_FUSED_PLAN_NAME_RE = None
+_FUSED_STRUCT_NAME_RE = None
 
 
 def _vm_cache_entry_stale(name: str) -> bool:
     """True when a ``.vm_cache`` entry can NEVER hit again in this source
     tree: its version prefix is not the current ``_VM_CACHE_VERSION``, or
     it names a known program kind whose per-program fingerprint has moved
-    (the builder was edited). Fused lowering plans
-    (``fused_l<lowering>_v<cache>_<fp>_<kind>_…``) additionally re-key on
-    ``vm_compile.LOWERING_VERSION`` — a lowering change evicts every
-    fused artifact without touching the interpreter tensors, and vice
-    versa. Unknown kinds are kept — age/size still bound them — so a
-    checkout running older code is never sabotaged."""
-    global _VM_CACHE_NAME_RE, _FUSED_CACHE_NAME_RE
+    (the builder was edited). Fused structural plans
+    (``fusedplan_l<lowering>_v<cache>_<fp>_<kind>_…``) additionally
+    re-key on ``vm_compile.LOWERING_VERSION`` — a lowering change evicts
+    every fused artifact without touching the interpreter tensors, and
+    vice versa — and shared structure bodies
+    (``fusedstruct_l<lowering>_<hash>``) re-key on the lowering version
+    alone (their referenced-ness is ``prune_vm_cache``'s concern). The
+    RETIRED PR 13 per-program ``fused_l…`` keying is stale on sight:
+    nothing in this tree can ever read those entries again. Unknown
+    kinds are kept — age/size still bound them — so a checkout running
+    older code is never sabotaged."""
+    global _VM_CACHE_NAME_RE, _FUSED_PLAN_NAME_RE, _FUSED_STRUCT_NAME_RE
     if _VM_CACHE_NAME_RE is None:
         import re
 
         _VM_CACHE_NAME_RE = re.compile(
             r"^v(\d+)_([0-9a-f]+)_(.+)_k\d+_f\d+_w\d+x\d+_p\d+\.pkl$")
-        _FUSED_CACHE_NAME_RE = re.compile(
-            r"^fused_l(\d+)_v(\d+)_([0-9a-f]+)_(.+)_k\d+_f\d+"
+        _FUSED_PLAN_NAME_RE = re.compile(
+            r"^fusedplan_l(\d+)_v(\d+)_([0-9a-f]+)_(.+)_k\d+_f\d+"
             r"_w\d+x\d+_p\d+_c\d+\.pkl$")
-    if name.startswith("fused_"):
-        m = _FUSED_CACHE_NAME_RE.match(name)
+        _FUSED_STRUCT_NAME_RE = re.compile(
+            r"^fusedstruct_l(\d+)_([0-9a-f]+)\.pkl$")
+    if name.startswith("fusedplan_"):
+        m = _FUSED_PLAN_NAME_RE.match(name)
         if not m:
             return False
         from . import vm_compile
@@ -295,6 +306,17 @@ def _vm_cache_entry_stale(name: str) -> bool:
         if kind in vmlib.BUILDERS and fp != _program_fingerprint(kind):
             return True
         return False
+    if name.startswith("fusedstruct_"):
+        m = _FUSED_STRUCT_NAME_RE.match(name)
+        if not m:
+            return False
+        from . import vm_compile
+
+        return int(m.group(1)) != vm_compile.LOWERING_VERSION
+    if name.startswith("fused_"):
+        # the PR 13 per-program fused plan keying, superseded by the
+        # structural split above: evict on sight regardless of version
+        return True
     m = _VM_CACHE_NAME_RE.match(name)
     if not m:
         return False
@@ -315,13 +337,22 @@ def prune_vm_cache(max_age_days: float = None, max_bytes: int = None,
 
     - entries whose cache version or per-program fingerprint no longer
       matches the current sources are evicted immediately (they can never
-      hit again; ``evict_stale=False`` disables);
+      hit again; ``evict_stale=False`` disables) — including every entry
+      of the RETIRED PR 13 per-program ``fused_l…`` keying, superseded by
+      the structural ``fusedplan_``/``fusedstruct_`` split;
     - entries idle longer than ``max_age_days`` are evicted
       (env VM_CACHE_MAX_AGE_DAYS, default 30; <= 0 disables the age rule;
       ``_program`` touches entries on every disk hit, so mtime == last
       use);
     - if the cache still exceeds ``max_bytes`` the oldest entries go until
-      it fits (env VM_CACHE_MAX_BYTES, default 2 GiB; <= 0 disables).
+      it fits (env VM_CACHE_MAX_BYTES, default 2 GiB; <= 0 disables);
+    - SHARED structure bodies (``fusedstruct_…``, referenced by any
+      number of plans) follow their referencing plans, not the age/size
+      rules: a structure referenced by at least one surviving
+      ``fusedplan_`` entry is kept, an orphaned one is evicted (it is
+      re-derived in milliseconds if ever needed again). A plan whose
+      refs cannot be read contributes no refs — its structures fall out
+      and the next load falls back to re-derivation rather than erroring.
 
     Returns {"kept", "evicted", "kept_bytes", "evicted_bytes"}."""
     if max_age_days is None:
@@ -333,6 +364,7 @@ def prune_vm_cache(max_age_days: float = None, max_bytes: int = None,
         cache_dir = _vm_cache_dir()
     now = time.time()
     entries = []  # (mtime, size, path)
+    structs = []  # (mtime, size, path, name) — referenced-ness governed
     evict = []
     for name in os.listdir(cache_dir):
         # cache entries plus crash-orphaned "<name>.pkl.<pid>.tmp" files
@@ -348,6 +380,9 @@ def prune_vm_cache(max_age_days: float = None, max_bytes: int = None,
         if evict_stale and name.endswith(".pkl") and _vm_cache_entry_stale(name):
             evict.append((st.st_mtime, st.st_size, path))
             continue
+        if name.startswith("fusedstruct_") and name.endswith(".pkl"):
+            structs.append((st.st_mtime, st.st_size, path, name))
+            continue
         entries.append((st.st_mtime, st.st_size, path))
     entries.sort()  # oldest (least recently used) first
     if max_age_days > 0:
@@ -360,6 +395,26 @@ def prune_vm_cache(max_age_days: float = None, max_bytes: int = None,
             oldest = entries.pop(0)
             total -= oldest[1]
             evict.append(oldest)
+    # structure entries: keep while any SURVIVING plan references them
+    if structs:
+        import pickle
+
+        referenced = set()
+        for _, _, path in entries:
+            if not os.path.basename(path).startswith("fusedplan_"):
+                continue
+            try:
+                with open(path, "rb") as fh:
+                    refs = pickle.load(fh).get("struct_refs") or ()
+                referenced.update(refs)
+            except Exception:
+                pass  # unreadable plan: contributes no refs
+        for mt, size, path, name in structs:
+            key = name[:-len(".pkl")].rsplit("_", 1)[-1]
+            if key in referenced:
+                entries.append((mt, size, path))
+            else:
+                evict.append((mt, size, path))
     evicted_bytes = 0
     evicted_entries = 0
     for _, size, path in evict:

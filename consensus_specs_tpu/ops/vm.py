@@ -1017,12 +1017,15 @@ def execute(program: Program, inputs: Dict[str, np.ndarray], batch_shape=(),
                 stacked, template, program.input_regs, program.output_regs,
                 instr, mesh,
             )
-            # block BEFORE the timer stops: jax dispatch is async (CPU
-            # included), and the routing ledger below compares this dt
-            # against the fused path's (which blocks inside run_fused) —
-            # an unblocked interp dt records dispatch, not compute, and
-            # would pin ``auto`` on the interpreter forever
-            out.block_until_ready()
+        # block BEFORE the timer stops, on BOTH backends: jax dispatch is
+        # async (CPU included), and the routing ledger below compares the
+        # two paths' dt against each other — an unblocked dt records
+        # dispatch, not compute, and would poison the measured-winner
+        # ``auto`` route. The fused path already materialized inside
+        # run_fused (inside the try above, so async runtime failures
+        # fall back to the interpreter too); this block is what times
+        # the interpreter path and is a no-op re-block for fused.
+        out.block_until_ready()
     dt = time.perf_counter() - t0
     # per-program measured ms/row, per backend: the ledger the ``auto``
     # route reads (fused first-shape calls are compile-inclusive and

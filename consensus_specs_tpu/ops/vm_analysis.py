@@ -485,15 +485,18 @@ def program_stats(assembled) -> Optional[Dict]:
 # ---------------------------------------------------------------------------
 
 
-def lowering_plan(assembled, chunk_steps: int = None) -> Dict:
+def lowering_plan(assembled, chunk_steps: int = None,
+                  boundaries: List[int] = None) -> Dict:
     """Per-level op lists + chunk-boundary live sets for the fused lowering.
 
     For every scheduled level, the REAL (non-idle) lanes of each unit as
     ``(a_regs, b_regs, dst_regs)`` columns (lin split into add/sub — the
     is_sub flag becomes a static branch, not a runtime select), and every
-    ``chunk_steps`` levels an EXACT live-in register set from a backward
-    liveness pass over the schedule — the carry each traced level-group
-    function receives from the previous one.
+    ``chunk_steps`` levels — or at each EXPLICIT ``boundaries`` start
+    (the period-resynced chunking of ``periodic_boundaries``) — an EXACT
+    live-in register set from a backward liveness pass over the schedule
+    — the carry each traced level-group function receives from the
+    previous one.
 
     Constant registers and the always-zero scratch register are excluded
     from live sets while their PRELOADED value is the live one (the
@@ -550,7 +553,13 @@ def lowering_plan(assembled, chunk_steps: int = None) -> Dict:
             return const_redef.get(reg, sched) < boundary
         return True
 
-    starts = list(range(0, sched, chunk_steps))
+    if boundaries is not None:
+        starts = sorted(set(int(s) for s in boundaries if 0 <= s < sched))
+        if not starts or starts[0] != 0:
+            starts = [0] + [s for s in starts if s != 0]
+    else:
+        starts = list(range(0, sched, chunk_steps))
+    start_index = {s: i for i, s in enumerate(starts)}
     live = set(out_regs)
     live_in: List[List[int]] = [[] for _ in starts]
     for t in range(sched - 1, -1, -1):
@@ -560,11 +569,12 @@ def lowering_plan(assembled, chunk_steps: int = None) -> Dict:
         for unit in ("mul", "add", "sub"):
             live.update(lv[unit][0])
             live.update(lv[unit][1])
-        if t % chunk_steps == 0:
-            ci = t // chunk_steps
+        ci = start_index.get(t)
+        if ci is not None:
             live_in[ci] = sorted(r for r in live if _carryable(r, t))
     chunks = [
-        {"start": s, "stop": min(s + chunk_steps, sched),
+        {"start": s,
+         "stop": starts[i + 1] if i + 1 < len(starts) else sched,
          "live_in": live_in[i]}
         for i, s in enumerate(starts)
     ]
@@ -578,6 +588,454 @@ def lowering_plan(assembled, chunk_steps: int = None) -> Dict:
         "consts": {int(r): v for r, v in assembled.const_regs.items()},
         "n_mul": n_mul,
         "n_lin": n_lin,
+    }
+
+
+# ---------------------------------------------------------------------------
+# structural canonicalization (ISSUE 15): the dedup artifacts the fused
+# backend compiles ONCE per distinct chunk shape — a square-and-multiply
+# ladder is a handful of level-chunk structures stamped out hundreds of
+# times, so canonicalizing each chunk up to constant values and live-set
+# permutation collapses the XLA compile bill from one-per-chunk to
+# one-per-structure
+# ---------------------------------------------------------------------------
+
+# measured XLA CPU compile cost of the straight-line lowering: ~0.4 s
+# per scheduled level (TPU_NOTES' chunk economics) plus a ~2 s fixed
+# cost per compile UNIT (jax trace + lowering + XLA's fixed passes —
+# visible once dedup shrinks the per-level share; fit to the measured
+# g2_subgroup window-14 warm of ~60 s over 13 units / 98 levels)
+FUSED_COMPILE_S_PER_LEVEL = 0.4
+FUSED_COMPILE_S_PER_UNIT = 2.0
+
+# period detection bounds: the level-signature autocorrelation scan looks
+# for the smallest period whose pairwise match fraction clears MIN_MATCH
+# (boundary chunks and sparse set-bit interruptions keep it under 1.0 —
+# g2_subgroup measures 0.97 at period 14, g1_subgroup 0.9+ at 6)
+PERIOD_MAX = 96
+PERIOD_MIN_MATCH = 0.85
+
+
+def level_signatures(plan: Dict) -> List[Tuple[int, int, int]]:
+    """Cheap per-level shape signature of a lowering plan: (mul, add, sub)
+    real-lane counts — the autocorrelation key ``detect_period`` scans."""
+    return [
+        (len(lv["mul"][2]), len(lv["add"][2]), len(lv["sub"][2]))
+        for lv in plan["levels"]
+    ]
+
+
+def detect_period(sigs: List, max_period: int = PERIOD_MAX,
+                  min_match: float = PERIOD_MIN_MATCH) -> Optional[int]:
+    """Smallest p such that ``sigs[i] == sigs[i+p]`` for at least
+    ``min_match`` of all comparable i — the ladder period of a
+    square-and-multiply schedule (None for aperiodic programs like the
+    hard part's dense addition chain, where structural dedup degrades
+    gracefully to exact-window matching)."""
+    n = len(sigs)
+    for p in range(1, min(max_period, n // 2) + 1):
+        matches = 0
+        for i in range(n - p):
+            if sigs[i] == sigs[i + p]:
+                matches += 1
+        if n - p and matches / (n - p) >= min_match:
+            return p
+    return None
+
+
+def periodic_boundaries(sigs: List, period: int,
+                        target: int) -> Optional[List[int]]:
+    """Chunk starts RE-SYNCED to the ladder period at irregularities.
+
+    Uniform windows keep one phase only until the first irregular row
+    (a set-bit product, the prologue) shifts it — after which every
+    steady window lands on a different phase and canonicalizes to a
+    fresh structure. Here steady chunks are single-period windows
+    anchored to ONE reference pattern (the first self-repeating period
+    of the signature stream), and the irregular levels between steady
+    regions become their own short chunks (capped at ``target``
+    levels): every steady chunk across ALL regions shares a phase, so
+    a sparse-exponent ladder collapses to one steady structure plus a
+    handful of short irregular ones. Returns None when no reference
+    period exists (the caller keeps uniform windows)."""
+    n = len(sigs)
+    ref = None
+    for i in range(n - 2 * period + 1):
+        if all(sigs[i + j] == sigs[i + period + j] for j in range(period)):
+            ref = sigs[i:i + period]
+            break
+    if ref is None:
+        return None
+
+    def anchored(i: int) -> bool:
+        return (i + period <= n
+                and all(sigs[i + j] == ref[j] for j in range(period)))
+
+    starts = []
+    i = 0
+    while i < n:
+        starts.append(i)
+        if anchored(i):
+            i += period
+            continue
+        j = i + 1
+        while j < n and (j - i) < target and not anchored(j):
+            j += 1
+        i = j
+    return starts
+
+
+def scan_blocks(instances: List[Dict], min_run: int) -> List[tuple]:
+    """Executor segmentation shared with the cold-cost model:
+    ``("step", ci)`` / ``("scan", ci, length)`` entries covering every
+    instance in order. Qualifying runs decompose into FIXED-SIZE scan
+    blocks per (structure, carry width) — the pow2 floor of that
+    structure's shortest run, clamped [2, 32] — so ONE compiled scan
+    executable serves every run of the structure regardless of run
+    length; remainder instances ride the structure's step unit."""
+    segments: List[tuple] = []
+    n = len(instances)
+    if not min_run:
+        return [("step", ci) for ci in range(n)]
+    runs = superop_runs(instances, min_run)
+    block: Dict[tuple, int] = {}
+    for s, r in runs:
+        key = (instances[s]["struct"], instances[s]["m_in"])
+        block[key] = min(block.get(key, 1 << 30), r)
+    for key, shortest in block.items():
+        b = 2
+        while b * 2 <= min(shortest, 32):
+            b *= 2
+        block[key] = b
+    run_at = dict(runs)
+    ci = 0
+    while ci < n:
+        r = run_at.get(ci)
+        if r:
+            b = block[(instances[ci]["struct"], instances[ci]["m_in"])]
+            end = ci + r
+            while ci + b <= end:
+                segments.append(("scan", ci, b))
+                ci += b
+            while ci < end:
+                segments.append(("step", ci))
+                ci += 1
+        else:
+            segments.append(("step", ci))
+            ci += 1
+    return segments
+
+
+def predicted_cold_cost(instances: List[Dict],
+                        segments: List[tuple]) -> Tuple[int, int, float]:
+    """(compile units, levels to compile, predicted seconds) for one
+    segmented structural plan — the executor compiles one unit per
+    distinct (mode, structure, shapes) key, so the prediction walks the
+    same key space."""
+    seen = set()
+    units = 1  # the entry widen
+    levels = 0
+    for seg in segments:
+        c = instances[seg[1]]
+        if seg[0] == "step":
+            key = ("step", c["struct"], c["m_in"], c["m_out"])
+        else:
+            key = ("scan", c["struct"], c["m_in"], seg[2])
+        if key in seen:
+            continue
+        seen.add(key)
+        units += 1
+        levels += c["stop"] - c["start"]
+    seconds = round(levels * FUSED_COMPILE_S_PER_LEVEL
+                    + units * FUSED_COMPILE_S_PER_UNIT, 1)
+    return units, levels, seconds
+
+
+def auto_min_run(plan: Dict) -> int:
+    """The super-op auto rule: fold runs (min length 3) when the
+    per-level dispatch glue outweighs the real per-level ALU work under
+    the FUSED_COST_* model — the fold-1 ladder regime where the
+    measured ~30 µs/level XLA launch overhead dominates."""
+    sched = max(1, int(plan.get("sched_steps", 1)))
+    work_us = (plan.get("n_mul", 0) * FUSED_COST_US_PER_MUL
+               + plan.get("n_lin", 0) * FUSED_COST_US_PER_LIN)
+    glue_us = sched * FUSED_COST_US_PER_LEVEL
+    return 3 if glue_us >= work_us else 0
+
+
+def plan_structures(assembled, chunk_target: int, dedup: bool = True,
+                    min_run: Optional[int] = None):
+    """The structural planning pipeline shared by the fused executor
+    (ops/vm_compile.py) and vmlint: derive the level columns, detect
+    the ladder period, build BOTH boundary candidates — the uniform
+    period-aligned window and the period-RESYNCED boundaries — and keep
+    whichever predicts the lower cold-compile cost under the measured
+    model (irregular regions dedup differently per program: resync wins
+    sparse-exponent ladders, uniform wins schedules whose gaps don't
+    repeat). ``min_run`` None = the ``auto_min_run`` cost-model rule.
+
+    Returns ``(plan_src, sp, info)``: the lowering plan whose chunking
+    won, its structural split, and
+    ``{"window", "period", "resync", "min_run", "units", "levels",
+    "predicted_cold_s"}``."""
+    plan_src = lowering_plan(assembled, chunk_steps=chunk_target)
+    if min_run is None:
+        min_run = auto_min_run(plan_src)
+    if not dedup:
+        sp = structural_plan(plan_src, dedup=False)
+        segs = [("step", ci) for ci in range(len(sp["instances"]))]
+        units, levels, cold = predicted_cold_cost(sp["instances"], segs)
+        return plan_src, sp, {
+            "window": chunk_target, "period": None, "resync": False,
+            "min_run": 0, "units": units, "levels": levels,
+            "predicted_cold_s": cold,
+        }
+    sigs = level_signatures(plan_src)
+    period = detect_period(sigs)
+    window = select_window(period, chunk_target)
+    plan_w = (plan_src if window == chunk_target
+              else lowering_plan(assembled, chunk_steps=window))
+    candidates = [(plan_w, structural_plan(plan_w), False)]
+    if period:
+        starts = periodic_boundaries(sigs, period, chunk_target)
+        if starts:
+            plan_r = lowering_plan(assembled, boundaries=starts)
+            candidates.append((plan_r, structural_plan(plan_r), True))
+    best = None
+    for plan_c, sp_c, resync in candidates:
+        segs = scan_blocks(sp_c["instances"], min_run)
+        units, levels, cold = predicted_cold_cost(sp_c["instances"], segs)
+        if best is None or cold < best[2]["predicted_cold_s"]:
+            best = (plan_c, sp_c, {
+                "window": window, "period": period, "resync": resync,
+                "min_run": min_run, "units": units, "levels": levels,
+                "predicted_cold_s": cold,
+            })
+    return best
+
+
+def select_window(period: Optional[int], target: int) -> int:
+    """Chunk window for the fused lowering: the largest multiple of the
+    detected ladder period NOT ABOVE ``target`` (so every steady-state
+    window lands on the same phase and canonicalizes to ONE structure),
+    or the period itself when it exceeds the target — clamped within 2x
+    of the configured target so an explicit
+    CONSENSUS_SPECS_TPU_VM_FUSED_CHUNK override keeps its meaning.
+    Floor rather than nearest on purpose: a smaller period-aligned
+    window compiles proportionally fewer levels per distinct structure
+    (measured on g2_subgroup: window 14 reaches fused-ready in ~60 s
+    cold vs ~97 s at window 28, warm ms/row equal within noise — the
+    scan super-ops erase the small-chunk dispatch penalty that made
+    sub-24 chunks a bad deal in PR 13). Aperiodic programs keep the
+    target unchanged."""
+    if not period:
+        return target
+    w = period * max(1, target // period)
+    if w > 2 * target or 2 * w < target:
+        return target
+    return w
+
+
+def structural_plan(plan: Dict, dedup: bool = True) -> Dict:
+    """Canonicalize every chunk of a lowering plan up to constant values
+    and live-set permutation.
+
+    Each chunk's body is renamed into a canonical SSA form: live-in
+    registers become input slots numbered by first use, constant
+    registers still holding their preload become const slots (their
+    VALUES move to per-instance operand tables — two ladder iterations
+    with different bit constants share one structure), the always-zero
+    scratch register stays a literal, and defs number off in schedule
+    order. The chunk's live-out defs (canonical ``out`` list) plus the
+    canonical level ops hash into the structure key; everything
+    instance-specific — which carry position feeds which input slot, the
+    constant values, and how the next boundary's carry assembles from
+    [body outputs ++ incoming carry] — lands in per-instance
+    ``in_idx`` / ``consts`` / ``boundary_idx`` tables the executor feeds
+    as RUNTIME operands, so XLA compiles once per distinct structure and
+    replays it everywhere the canonical form matches (across chunks,
+    programs, and — via the plan being shape-free — batch shapes).
+
+    The INTER-chunk carry is width-NORMALIZED: every boundary layout
+    pads (with dead slots, never read) to the program's widest live
+    boundary, so chunks whose structures match also share their compile
+    shapes — without this, a program that steadily consumes its inputs
+    (the RLC combine eating its f coefficients) drifts the carry width
+    every chunk and fragments otherwise-identical structures into
+    per-shape XLA compiles. The entry (the program's input stack) and
+    the exit (the output layout) keep their exact widths.
+
+    Returns ``{"structs": {key: body}, "instances": [...]}`` where body =
+    ``{"levels", "out", "n_in", "n_const"}`` and each instance =
+    ``{"struct", "in_idx", "consts", "boundary_idx", "m_in", "m_out",
+    "start", "stop"}``. ``dedup=False`` salts every key with its chunk
+    index — the PR 13 one-compile-per-chunk baseline the cold benchmark
+    races against."""
+    import hashlib
+
+    levels = plan["levels"]
+    chunks = plan["chunks"]
+    consts = plan["consts"]
+    structs: Dict[str, Dict] = {}
+    instances: List[Dict] = []
+    n_ch = len(chunks)
+    # normalized inter-chunk carry width (entry and exit stay exact)
+    m_norm = max(
+        (len(c["live_in"]) for c in chunks[1:]), default=0)
+    for ci, ch in enumerate(chunks):
+        s, e = ch["start"], ch["stop"]
+        in_layout = plan["inputs"] if ci == 0 else ch["live_in"]
+        m_in = len(in_layout) if ci == 0 else m_norm
+        out_layout = (chunks[ci + 1]["live_in"] if ci + 1 < n_ch
+                      else plan["outputs"])
+        m_out = m_norm if ci + 1 < n_ch else len(out_layout)
+        pos_in: Dict[int, int] = {}
+        for i, r in enumerate(in_layout):
+            pos_in.setdefault(r, i)
+        env: Dict[int, Tuple[str, int]] = {}
+        in_refs: List[int] = []  # canonical input slot -> source register
+        const_vals: List[int] = []
+        defs: List[int] = []  # canonical def id -> destination register
+        canon_levels = []
+
+        def resolve(r: int) -> Tuple[str, int]:
+            if r == 0:
+                return ("z", 0)
+            v = env.get(r)
+            if v is None:
+                # carry beats const: a const register redefined in an
+                # EARLIER chunk rides the carry (live_in lists it), only
+                # a still-preloaded const becomes a const operand slot
+                if r in pos_in:
+                    v = ("i", len(in_refs))
+                    in_refs.append(r)
+                elif r in consts:
+                    v = ("c", len(const_vals))
+                    const_vals.append(consts[r])
+                else:
+                    raise KeyError(
+                        f"structural_plan: register {r} has no value at "
+                        f"chunk {ci} (lowering-plan liveness bug)")
+                env[r] = v
+            return v
+
+        for t in range(s, e):
+            lv = levels[t]
+            row = []
+            new: Dict[int, Tuple[str, int]] = {}
+            for unit in ("mul", "add", "sub"):
+                aa, bb, dd = lv[unit]
+                row.append([[resolve(a), resolve(b)]
+                            for a, b in zip(aa, bb)])
+                for d in dd:
+                    new[d] = ("d", len(defs))
+                    defs.append(d)
+            # defs become visible at the NEXT level only (the interpreter
+            # reads the pre-step register file)
+            env.update(new)
+            canon_levels.append(row)
+
+        out_set = set(out_layout)
+        out_ids = [i for i, r in enumerate(defs)
+                   if env.get(r) == ("d", i) and r in out_set]
+        raw = json.dumps(
+            [canon_levels, out_ids, len(in_refs), len(const_vals)],
+            separators=(",", ":"))
+        if not dedup:
+            raw = f"{ci}|{raw}"
+        key = hashlib.sha256(raw.encode()).hexdigest()[:24]
+        if key not in structs:
+            structs[key] = {
+                "levels": canon_levels,
+                "out": out_ids,
+                "n_in": len(in_refs),
+                "n_const": len(const_vals),
+            }
+        def_slot = {d: j for j, d in enumerate(out_ids)}
+        n_out = len(out_ids)
+        boundary_idx = []
+        for r in out_layout:
+            v = env.get(r)
+            if v is not None and v[0] == "d":
+                boundary_idx.append(def_slot[v[1]])
+            else:
+                # pass-through: the value rides the incoming carry,
+                # appended after the body outputs in the merge gather
+                boundary_idx.append(n_out + pos_in[r])
+        while len(boundary_idx) < m_out:
+            boundary_idx.append(0)  # dead pad slot: never read
+        instances.append({
+            "struct": key,
+            "in_idx": [pos_in[r] for r in in_refs],
+            "consts": const_vals,
+            "boundary_idx": boundary_idx,
+            "m_in": m_in,
+            "m_out": m_out,
+            "start": s,
+            "stop": e,
+        })
+    return {"structs": structs, "instances": instances}
+
+
+def superop_runs(instances: List[Dict],
+                 min_run: int = 3) -> List[Tuple[int, int]]:
+    """Maximal runs of consecutive instances foldable into ONE scan
+    super-op: same structure and a shape-invariant carry (``m_in ==
+    m_out`` throughout, so the lax.scan carry keeps one shape while the
+    per-instance operand tables ride the scan axis). Returns
+    ``[(first_instance_index, run_length), ...]`` for runs of at least
+    ``min_run``."""
+    runs = []
+    i = 0
+    n = len(instances)
+    while i < n:
+        a = instances[i]
+        j = i
+        if a["m_in"] == a["m_out"]:
+            while (j + 1 < n
+                   and instances[j + 1]["struct"] == a["struct"]
+                   and instances[j + 1]["m_in"] == a["m_in"]
+                   and instances[j + 1]["m_out"] == a["m_out"]):
+                j += 1
+        if j - i + 1 >= max(2, min_run):
+            runs.append((i, j - i + 1))
+        i = j + 1
+    return runs
+
+
+def structural_stats(assembled, chunk_target: int = None) -> Dict:
+    """The vmlint-facing dedup summary for one assembled program:
+    detected period, chosen window/boundary mode, chunk count vs
+    distinct structural chunk shapes, the dedup ratio, how many chunks
+    fold into scan super-op runs, and the predicted cold XLA compile
+    bill with and without dedup — the exact planning pipeline the fused
+    executor runs (``plan_structures``), so the committed numbers ARE
+    the backend's decisions."""
+    if chunk_target is None:
+        chunk_target = FUSED_CHUNK_STEPS
+    plan, sp, info = plan_structures(assembled, chunk_target)
+    instances = sp["instances"]
+    n_chunks = len(instances)
+    distinct = len(sp["structs"])
+    run_chunks = sum(
+        r for _, r in superop_runs(instances, max(2, info["min_run"]))
+    ) if info["min_run"] else 0
+    total_levels = plan["sched_steps"]
+    nodedup_chunks = -(-total_levels // chunk_target) if total_levels else 0
+    return {
+        "period": info["period"],
+        "window": info["window"],
+        "resync": info["resync"],
+        "chunks": n_chunks,
+        "distinct_structs": distinct,
+        "dedup_ratio": round(n_chunks / distinct, 2) if distinct else 1.0,
+        "superop_run_chunks": run_chunks,
+        "compile_units": info["units"],
+        "compile_levels": info["levels"],
+        "predicted_cold_s": info["predicted_cold_s"],
+        "predicted_cold_nodedup_s": round(
+            total_levels * FUSED_COMPILE_S_PER_LEVEL
+            + (nodedup_chunks + 1) * FUSED_COMPILE_S_PER_UNIT, 1),
     }
 
 
@@ -643,6 +1101,7 @@ def analyze_prog(prog, name: str = "<prog>", w_mul: int = 128,
     bounds = check_bounds(prog)
     pressure = check_pressure(prog, assembled, keep_per_step=keep_per_step)
     cost = check_cost(prog, assembled, w_mul, w_lin)
+    structure = structural_stats(assembled)
     findings = (bounds.pop("errors") + bounds.pop("warnings")
                 + pressure.pop("findings"))
     return {
@@ -656,6 +1115,7 @@ def analyze_prog(prog, name: str = "<prog>", w_mul: int = 128,
         "bounds": bounds,
         "pressure": pressure,
         "cost": cost,
+        "structure": structure,
         "findings": findings,
         "errors": sum(1 for f in findings if f["severity"] == "error"),
         "warnings": sum(1 for f in findings if f["severity"] == "warn"),
@@ -794,6 +1254,13 @@ def baseline_entry(report: Dict) -> Dict:
         # lowering decision reads off the committed baseline
         "predicted_row_s": report["cost"]["predicted_row_s"],
         "predicted_fused_row_s": report["cost"]["predicted_fused_row_s"],
+        # informational too: the ISSUE 15 structural-dedup shape — how many
+        # distinct chunk structures the fused backend compiles per program
+        # and the cold-compile prediction that buys
+        "distinct_structs": report["structure"]["distinct_structs"],
+        "struct_chunks": report["structure"]["chunks"],
+        "dedup_ratio": report["structure"]["dedup_ratio"],
+        "predicted_cold_s": report["structure"]["predicted_cold_s"],
     }
 
 

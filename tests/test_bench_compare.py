@@ -757,6 +757,36 @@ def test_vmexec_new_cells_are_not_gated_until_seen(tmp_path, bc):
     assert bc.main(["--dir", str(tmp_path)]) == 0
 
 
+def test_vmexec_cold_cells_ride_the_state_gate(tmp_path, bc, capsys):
+    """ISSUE 15: the fresh-process cold-start cells (`cold,<kind>` ok =
+    fused-ready + bit-identical + within the seconds-scale budget;
+    `cold_nodedup,<kind>` the per-chunk baseline arm) are ordinary
+    vmexec cells to the gate — a round whose cold arm stops fitting
+    (ok True -> False) fails, while ready_s movement alone is
+    report-only (the cells carry no ms_row keys, which coerce to 0)."""
+    def cold(ok, ready):
+        return {"ok": ok, "ready_s": ready, "within_budget": ok,
+                "distinct_structs": 7, "chunks": 69}
+
+    _write_round(tmp_path, 1, _parsed(
+        5.5, mode="vmexec", n=None, k=None,
+        vmexec={"cold,g2_subgroup": cold(True, 79.0),
+                "cold_nodedup,g2_subgroup": cold(True, 430.0)}))
+    _write_round(tmp_path, 2, _parsed(
+        5.5, mode="vmexec", n=None, k=None,
+        vmexec={"cold,g2_subgroup": cold(True, 95.0),  # slower: fine
+                "cold_nodedup,g2_subgroup": cold(True, 500.0)}))
+    assert bc.main(["--dir", str(tmp_path)]) == 0
+    _write_round(tmp_path, 3, _parsed(
+        5.5, mode="vmexec", n=None, k=None,
+        vmexec={"cold,g2_subgroup": cold(False, 600.0),  # over budget
+                "cold_nodedup,g2_subgroup": cold(True, 500.0)}))
+    assert bc.main(["--dir", str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "cpu:vmexec:cold,g2_subgroup" in out
+    assert "VMEXEC ERRORED" in out
+
+
 def test_vmexec_extract_shapes(bc):
     doc = {"parsed": _vx_parsed(
         5.5, {"g2_subgroup,1": (True, 46.3, 255.0)})}

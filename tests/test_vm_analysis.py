@@ -230,6 +230,107 @@ def test_program_stats_cross_checks_the_ir_analysis():
 
 
 # ---------------------------------------------------------------------------
+# structural canonicalization (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+
+def test_detect_period_and_window_selection():
+    a, b, c = (7, 0, 0), (2, 1, 0), (1, 0, 1)
+    assert vm_analysis.detect_period([a, b] * 40) == 2
+    assert vm_analysis.detect_period([a, b, c] * 30 + [a]) == 3
+    # sparse interruptions (the set-bit rows of a real ladder) survive
+    # the match-fraction threshold
+    sigs = ([a, b] * 20 + [c] + [a, b] * 20)
+    assert vm_analysis.detect_period(sigs) == 2
+    # aperiodic: no period
+    import random as _r
+
+    rng = _r.Random(5)
+    rand = [(rng.randrange(50), rng.randrange(9), rng.randrange(9))
+            for _ in range(200)]
+    assert vm_analysis.detect_period(rand) is None
+    # window selection: largest period multiple <= target, 2x-clamped
+    assert vm_analysis.select_window(None, 24) == 24
+    assert vm_analysis.select_window(14, 24) == 14
+    assert vm_analysis.select_window(6, 24) == 24
+    assert vm_analysis.select_window(28, 24) == 28  # period > target: itself
+    assert vm_analysis.select_window(96, 24) == 24  # > 2x target: clamped
+
+
+def _ladder_prog(iters=12):
+    prog = vm.Prog()
+    acc = prog.inp("acc")
+    other = prog.inp("other")
+    for i in range(iters):
+        k = prog.const(1000003 * (i + 1))
+        acc = acc * acc + other * k
+        other = other * other - acc
+    prog.out(acc, "acc")
+    prog.out(other, "other")
+    return prog
+
+
+def test_structural_plan_dedups_ladder_chunks():
+    """A repeated loop body canonicalizes to FEWER distinct structures
+    than chunks — constants become per-instance operand slots, carry
+    wiring becomes per-instance gather tables — and every instance's
+    tables are self-consistent (index ranges, struct refs)."""
+    prog = _ladder_prog()
+    assembled = prog.assemble(w_mul=64, w_lin=64, pad_steps_to=256,
+                              pad_regs_to=64)
+    plan = vm_analysis.lowering_plan(assembled, chunk_steps=3)
+    sp = vm_analysis.structural_plan(plan)
+    inst = sp["instances"]
+    assert len(sp["structs"]) < len(inst)
+    for c in inst:
+        body = sp["structs"][c["struct"]]
+        assert len(c["in_idx"]) == body["n_in"]
+        assert len(c["consts"]) == body["n_const"]
+        assert len(c["boundary_idx"]) == c["m_out"]
+        assert all(0 <= i < c["m_in"] for i in c["in_idx"])
+        n_out = len(body["out"])
+        assert all(0 <= i < n_out + c["m_in"]
+                   for i in c["boundary_idx"])
+    # dedup=False salts every key: the per-chunk baseline
+    sp0 = vm_analysis.structural_plan(plan, dedup=False)
+    assert len(sp0["structs"]) == len(sp0["instances"])
+    # the canonical bodies are instance-value-free: runs exist for the
+    # super-op folding to exploit
+    runs = vm_analysis.superop_runs(inst, min_run=2)
+    assert runs and max(r for _, r in runs) >= 4
+
+
+def test_superop_runs_require_shape_invariant_carry():
+    inst = [
+        {"struct": "A", "m_in": 4, "m_out": 4},
+        {"struct": "A", "m_in": 4, "m_out": 4},
+        {"struct": "A", "m_in": 4, "m_out": 4},
+        {"struct": "B", "m_in": 4, "m_out": 4},
+        {"struct": "A", "m_in": 4, "m_out": 6},  # width change: no run
+        {"struct": "A", "m_in": 6, "m_out": 6},
+        {"struct": "A", "m_in": 6, "m_out": 6},
+    ]
+    assert vm_analysis.superop_runs(inst, min_run=3) == [(0, 3)]
+    assert vm_analysis.superop_runs(inst, min_run=2) == [(0, 3), (5, 2)]
+    assert vm_analysis.superop_runs([], min_run=2) == []
+
+
+def test_structural_stats_report_shape():
+    st = vm_analysis.structural_stats(
+        _ladder_prog().assemble(w_mul=64, w_lin=64, pad_steps_to=256,
+                                pad_regs_to=64), chunk_target=4)
+    assert st["chunks"] >= st["distinct_structs"] >= 1
+    assert st["dedup_ratio"] >= 1.0
+    assert st["predicted_cold_s"] <= st["predicted_cold_nodedup_s"]
+    # the report + baseline entry carry the structural shape
+    r = vm_analysis.analyze_prog(_ladder_prog(), name="ladder")
+    assert r["structure"]["distinct_structs"] >= 1
+    entry = vm_analysis.baseline_entry(r)
+    assert entry["distinct_structs"] == r["structure"]["distinct_structs"]
+    assert entry["dedup_ratio"] == r["structure"]["dedup_ratio"]
+
+
+# ---------------------------------------------------------------------------
 # baseline gate
 # ---------------------------------------------------------------------------
 

@@ -275,47 +275,122 @@ def test_warm_fused_reports_compile_seconds(monkeypatch):
     assert vm_compile.warm_fused(assembled, ()) == 0.0  # in-process warm
 
 
-# -- fused .vm_cache key + prune rules (ISSUE 13 satellite) ----------------
+# -- fused .vm_cache key + prune rules (ISSUE 13 + 15 satellites) ----------
 
 
-def _fused_name(lowering=None, version=None, kind="g2_subgroup", fp=None):
+def _plan_name(lowering=None, version=None, kind="g2_subgroup", fp=None,
+               chunk=24):
     lowering = vm_compile.LOWERING_VERSION if lowering is None else lowering
     version = bb._VM_CACHE_VERSION if version is None else version
     fp = bb._program_fingerprint(kind) if fp is None else fp
-    return (f"fused_l{lowering}_v{version}_{fp}_{kind}"
-            f"_k0_f1_w96x192_p1024_c24.pkl")
+    return (f"fusedplan_l{lowering}_v{version}_{fp}_{kind}"
+            f"_k0_f1_w96x192_p1024_c{chunk}.pkl")
+
+
+def _struct_name(key="ab" * 12, lowering=None):
+    lowering = vm_compile.LOWERING_VERSION if lowering is None else lowering
+    return f"fusedstruct_l{lowering}_{key}.pkl"
 
 
 def test_fused_cache_stale_rules():
-    assert not bb._vm_cache_entry_stale(_fused_name())
+    assert not bb._vm_cache_entry_stale(_plan_name())
     # a lowering bump evicts fused plans WITHOUT touching interp tensors
     assert bb._vm_cache_entry_stale(
-        _fused_name(lowering=vm_compile.LOWERING_VERSION + 1))
+        _plan_name(lowering=vm_compile.LOWERING_VERSION + 1))
     assert bb._vm_cache_entry_stale(
-        _fused_name(version=bb._VM_CACHE_VERSION + 1))
+        _plan_name(version=bb._VM_CACHE_VERSION + 1))
     # a moved per-program fingerprint (edited builder) evicts too
-    assert bb._vm_cache_entry_stale(_fused_name(fp="00000000"))
+    assert bb._vm_cache_entry_stale(_plan_name(fp="00000000"))
     # unknown kinds are kept (age/size still bound them)
     assert not bb._vm_cache_entry_stale(
-        _fused_name(kind="not_a_builder", fp="00000000"))
-    # malformed fused names are kept, never crash
-    assert not bb._vm_cache_entry_stale("fused_weird.pkl")
+        _plan_name(kind="not_a_builder", fp="00000000"))
+    # shared structure bodies re-key on the lowering version alone
+    assert not bb._vm_cache_entry_stale(_struct_name())
+    assert bb._vm_cache_entry_stale(
+        _struct_name(lowering=vm_compile.LOWERING_VERSION + 1))
+    # the RETIRED PR 13 per-program keying is stale on sight — ANY
+    # version, including one matching the current numbers
+    assert bb._vm_cache_entry_stale(
+        f"fused_l{vm_compile.LOWERING_VERSION}_v{bb._VM_CACHE_VERSION}_"
+        f"{bb._program_fingerprint('g2_subgroup')}_g2_subgroup"
+        "_k0_f1_w96x192_p1024_c24.pkl")
+    assert bb._vm_cache_entry_stale("fused_l1_v2_cafe_g2_subgroup"
+                                    "_k0_f1_w96x192_p1024_c24.pkl")
+    assert bb._vm_cache_entry_stale("fused_weird.pkl")
+    # malformed new-prefix names are kept, never crash
+    assert not bb._vm_cache_entry_stale("fusedplan_weird.pkl")
+    assert not bb._vm_cache_entry_stale("fusedstruct_weird.pkl")
+
+
+def _write_plan_entry(tmp_path, refs, name=None):
+    import pickle
+
+    p = tmp_path / (name or _plan_name())
+    with open(p, "wb") as fh:
+        pickle.dump({"format": 2, "struct_refs": list(refs)}, fh)
+    return p
 
 
 def test_prune_evicts_stale_fused_entries(tmp_path):
-    stale = tmp_path / _fused_name(lowering=vm_compile.LOWERING_VERSION + 1)
-    fresh = tmp_path / _fused_name()
+    stale = tmp_path / _plan_name(lowering=vm_compile.LOWERING_VERSION + 1)
+    old_keying = tmp_path / (
+        "fused_l1_v2_cafe_g2_subgroup_k0_f1_w96x192_p1024_c24.pkl")
     interp = tmp_path / (
         f"v{bb._VM_CACHE_VERSION}_{bb._program_fingerprint('g2_subgroup')}"
         "_g2_subgroup_k0_f1_w96x192_p1024.pkl")
-    for p in (stale, fresh, interp):
+    for p in (stale, old_keying, interp):
         p.write_bytes(b"x" * 64)
+    fresh = _write_plan_entry(tmp_path, [])
     res = bb.prune_vm_cache(max_age_days=0, max_bytes=0,
                             cache_dir=str(tmp_path))
-    assert not stale.exists()  # old lowering version: gone immediately
-    assert fresh.exists()      # current fused artifact: kept
-    assert interp.exists()     # interp tensors: untouched by the bump
-    assert res["evicted"] == 1 and res["kept"] == 2
+    assert not stale.exists()      # old lowering version: gone immediately
+    assert not old_keying.exists()  # retired PR 13 keying: gone on sight
+    assert fresh.exists()          # current fused plan: kept
+    assert interp.exists()         # interp tensors: untouched by the bump
+    assert res["evicted"] == 2 and res["kept"] == 2
+
+
+def test_prune_keeps_referenced_structs_evicts_orphans(tmp_path):
+    key_live, key_orphan = "aa" * 12, "bb" * 12
+    live = tmp_path / _struct_name(key_live)
+    orphan = tmp_path / _struct_name(key_orphan)
+    for p in (live, orphan):
+        p.write_bytes(b"x" * 64)
+    plan = _write_plan_entry(tmp_path, [key_live])
+    # make everything "old": referenced structs must still survive the
+    # age rule because their referencing plan survives
+    import os as _os
+    import time as _time
+
+    old = _time.time() - 90 * 86400
+    _os.utime(live, (old, old))
+    _os.utime(orphan, (old, old))
+    res = bb.prune_vm_cache(max_age_days=365, max_bytes=0,
+                            cache_dir=str(tmp_path))
+    assert plan.exists()
+    assert live.exists()        # referenced: survives despite its age
+    assert not orphan.exists()  # no referencing plan: evicted
+    assert res["evicted"] == 1
+
+
+def test_prune_drops_structs_when_referencing_plan_goes(tmp_path):
+    """When the last referencing plan is age-evicted, its structures
+    orphan and go in the same prune; a corrupt plan contributes no refs
+    (and the loader side falls back to re-derivation, tested below)."""
+    key = "cc" * 12
+    struct = tmp_path / _struct_name(key)
+    struct.write_bytes(b"x" * 64)
+    plan = _write_plan_entry(tmp_path, [key])
+    import os as _os
+    import time as _time
+
+    old = _time.time() - 90 * 86400
+    _os.utime(plan, (old, old))
+    res = bb.prune_vm_cache(max_age_days=30, max_bytes=0,
+                            cache_dir=str(tmp_path))
+    assert not plan.exists()
+    assert not struct.exists()
+    assert res["evicted"] == 2
 
 
 def test_fused_key_rides_program_cache(tmp_path, monkeypatch):
@@ -336,6 +411,204 @@ def test_fused_key_rides_program_cache(tmp_path, monkeypatch):
         assert again.meta.get("fused_key") == key
     finally:
         bb._program.cache_clear()
+
+
+# -- structural dedup + super-op coarsening (ISSUE 15) ---------------------
+
+
+def _periodic_prog(iters=10):
+    """A ladder-shaped program: one fixed loop body stamped ``iters``
+    times — the structure class the chunk canonicalizer collapses. The
+    two chains consume each other so the scheduler keeps them in
+    lockstep (a constant steady-state live width, like the production
+    square-and-multiply ladders); the per-iteration constants prove
+    constants dedup as runtime operands."""
+    prog = vm.Prog()
+    acc = prog.inp("acc")
+    other = prog.inp("other")
+    for i in range(iters):
+        k = prog.const(1000003 * (i + 1))  # per-iteration constant
+        acc = acc * acc + other * k
+        other = other * other - acc
+    prog.out(acc, "acc")
+    prog.out(other, "other")
+    return prog
+
+
+def test_structural_dedup_collapses_chunks(monkeypatch):
+    """The ladder's repeated chunks must hash to FEWER distinct
+    structures than chunks, runs must fold into scan super-ops, and the
+    outputs must stay bit-identical to the interpreter + oracle."""
+    prog = _periodic_prog(iters=12)
+    assembled = prog.assemble(**BUCKET)
+    ints, arrs = _rand_inputs(prog)
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_VM_FUSED_CHUNK", "4")
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_VM_SUPEROP", "2")
+    out_i, out_f = _run_both(assembled, arrs, (), monkeypatch)
+    for name in out_i:
+        assert np.array_equal(np.asarray(out_i[name]),
+                              np.asarray(out_f[name])), name
+    want = vm_analysis.eval_ir(prog, ints[0])
+    for name, w in want.items():
+        assert fq.limbs_to_int(np.asarray(out_f[name])) == w
+    fp = vm_compile._FUSED[id(assembled)]
+    st = fp.struct_stats
+    assert st["distinct_structs"] < st["chunks"], st
+    assert st["superop_segments"] >= 1, st
+    # compile units actually dedup'd: fewer misses than chunks
+    assert vm_compile._COUNTERS["struct_misses"] < st["chunks"] + 1
+
+
+def test_superop_off_still_identical(monkeypatch):
+    prog = _periodic_prog(iters=8)
+    assembled = prog.assemble(**BUCKET)
+    ints, arrs = _rand_inputs(prog, rows=2)
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_VM_FUSED_CHUNK", "4")
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_VM_SUPEROP", "off")
+    out_i, out_f = _run_both(assembled, arrs, (2,), monkeypatch)
+    for name in out_i:
+        assert np.array_equal(np.asarray(out_i[name]),
+                              np.asarray(out_f[name])), name
+    fp = vm_compile._FUSED[id(assembled)]
+    assert fp.struct_stats["superop_segments"] == 0
+
+
+def test_dedup_off_pins_per_chunk_baseline(monkeypatch):
+    """CONSENSUS_SPECS_TPU_VM_DEDUP=0 is the PR 13 one-compile-per-chunk
+    baseline the cold bench races: every chunk its own structure, no
+    super-ops, identity unchanged."""
+    prog = _periodic_prog(iters=8)
+    assembled = prog.assemble(**BUCKET)
+    ints, arrs = _rand_inputs(prog)
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_VM_FUSED_CHUNK", "4")
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_VM_DEDUP", "0")
+    out_i, out_f = _run_both(assembled, arrs, (), monkeypatch)
+    for name in out_i:
+        assert np.array_equal(np.asarray(out_i[name]),
+                              np.asarray(out_f[name])), name
+    fp = vm_compile._FUSED[id(assembled)]
+    st = fp.struct_stats
+    assert st["distinct_structs"] == st["chunks"]
+    assert st["superop_segments"] == 0
+
+
+def test_struct_cache_shared_across_programs(monkeypatch):
+    """Two PROGRAMS with the same canonical chunk structure share the
+    in-process compiled structures: the second program's warm is all
+    structural hits, zero new compiles — and the batch shape SERVED
+    through those hits (a different (program, shape) pair than the one
+    that compiled them) stays bit-identical to the interpreter and the
+    exact-int oracle (the ISSUE 15 acceptance case)."""
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_VM_FUSED_CHUNK", "4")
+    a = _periodic_prog(iters=6).assemble(**BUCKET)
+    prog_b = _periodic_prog(iters=6)
+    b = prog_b.assemble(**BUCKET)  # fresh program, same canonical form
+    vm_compile.warm_fused(a, ())
+    misses_after_a = vm_compile._COUNTERS["struct_misses"]
+    assert misses_after_a > 0
+    vm_compile.warm_fused(b, ())
+    assert vm_compile._COUNTERS["struct_misses"] == misses_after_a
+    assert vm_compile._COUNTERS["struct_hits"] > 0
+    ints, arrs = _rand_inputs(prog_b)
+    out_i, out_f = _run_both(b, arrs, (), monkeypatch)
+    want = vm_analysis.eval_ir(prog_b, ints[0])
+    for name in out_i:
+        assert np.array_equal(np.asarray(out_i[name]),
+                              np.asarray(out_f[name])), name
+        assert fq.limbs_to_int(np.asarray(out_f[name])) == want[name]
+
+
+def test_corrupted_struct_entry_falls_back_to_rederive(
+        monkeypatch, tmp_path):
+    """A corrupted shared structure entry must make _load_plan return
+    None (the caller re-derives and re-stores) — never raise into the
+    execute path."""
+    monkeypatch.setattr(bb, "_vm_cache_dir", lambda: str(tmp_path))
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_VM_FUSED_CHUNK", "4")
+    prog = _periodic_prog(iters=6)
+    assembled = prog.assemble(**BUCKET)
+    assembled.meta["fused_key"] = ("synthetic", 0, 1, "cafe0123")
+    fp = vm_compile.fused_program(assembled)  # derives + stores
+    refs = sorted(fp.plan["structs"])
+    assert refs
+    for ref in refs:
+        spath = vm_compile._struct_cache_path(ref)
+        assert os.path.exists(spath), ref
+    # corrupt one structure entry on disk
+    with open(vm_compile._struct_cache_path(refs[0]), "wb") as fh:
+        fh.write(b"not a pickle")
+    assert vm_compile._load_plan(assembled) is None
+    # a fresh "process" still lowers fine (re-derive + re-store)
+    vm_compile.reset_fused_state()
+    fresh = prog.assemble(**BUCKET)
+    fresh.meta["fused_key"] = ("synthetic", 0, 1, "cafe0123")
+    ints, arrs = _rand_inputs(prog)
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_VM_EXEC", "fused")
+    out = vm.execute(fresh, arrs)
+    want = vm_analysis.eval_ir(prog, ints[0])
+    for name, w in want.items():
+        assert fq.limbs_to_int(np.asarray(out[name])) == w
+    assert vm_compile._load_plan(fresh) is not None  # re-stored intact
+
+
+def test_env_knob_hardening_warns_once(monkeypatch, capsys):
+    """Invalid or non-positive structural-dedup knobs warn ONCE on
+    stderr and fall back to the documented default — never raise."""
+    vm_compile._ENV_WARNED.clear()
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_VM_FUSED_CHUNK", "banana")
+    assert vm_compile.chunk_steps() == vm_analysis.FUSED_CHUNK_STEPS
+    assert vm_compile.chunk_steps() == vm_analysis.FUSED_CHUNK_STEPS
+    err = capsys.readouterr().err
+    assert err.count("CONSENSUS_SPECS_TPU_VM_FUSED_CHUNK") == 1
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_VM_FUSED_CHUNK", "-8")
+    vm_compile._ENV_WARNED.clear()
+    assert vm_compile.chunk_steps() == vm_analysis.FUSED_CHUNK_STEPS
+    assert "ignoring invalid" in capsys.readouterr().err
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_VM_DEDUP", "maybe")
+    vm_compile._ENV_WARNED.clear()
+    assert vm_compile.dedup_enabled() is True
+    assert "CONSENSUS_SPECS_TPU_VM_DEDUP" in capsys.readouterr().err
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_VM_SUPEROP", "1")
+    vm_compile._ENV_WARNED.clear()
+    assert vm_compile.superop_min_run({"sched_steps": 8, "n_mul": 1,
+                                       "n_lin": 1}) == 3  # auto fallback
+    assert "CONSENSUS_SPECS_TPU_VM_SUPEROP" in capsys.readouterr().err
+    # valid values parse silently
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_VM_FUSED_CHUNK", "7")
+    assert vm_compile.chunk_steps() == 7
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_VM_SUPEROP", "4")
+    assert vm_compile.superop_min_run({"sched_steps": 8}) == 4
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_VM_SUPEROP", "off")
+    assert vm_compile.superop_min_run({"sched_steps": 8}) == 0
+    assert capsys.readouterr().err == ""
+
+
+def test_background_warm_flips_auto_to_fused(monkeypatch):
+    """CONSENSUS_SPECS_TPU_VM_WARM_BG=1: an auto-routed call whose
+    measured winner is fused but whose shape is cold serves the
+    INTERPRETER and enqueues a background warm; once the warm lands,
+    auto flips to fused for that shape."""
+    prog = _mixed_prog(depth=2)
+    assembled = prog.assemble(**BUCKET)
+    ints, arrs = _rand_inputs(prog)
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_VM_FUSED_CHUNK", "6")
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_VM_EXEC", "auto")
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_VM_WARM_BG", "1")
+    assembled._exec_stats = {"fused_ms_row": 1.0, "interp_ms_row": 5.0}
+    # shape not compiled: the call must stay on the interpreter...
+    assert not vm_compile.use_fused(assembled, shape_sig=((), False))
+    before = vm_compile._COUNTERS["executions"]
+    out_cold = vm.execute(assembled, arrs)
+    assert vm_compile._COUNTERS["executions"] == before
+    # ...but the background warm flips the route once it lands
+    assert vm_compile.bg_warm_drain(timeout=120.0)
+    assembled._exec_stats = {"fused_ms_row": 1.0, "interp_ms_row": 5.0}
+    assert vm_compile.use_fused(assembled, shape_sig=((), False))
+    out_warm = vm.execute(assembled, arrs)
+    assert vm_compile._COUNTERS["executions"] == before + 1
+    for name in out_cold:
+        assert np.array_equal(np.asarray(out_cold[name]),
+                              np.asarray(out_warm[name])), name
 
 
 # -- `make native` discoverability warning (ISSUE 13 satellite) ------------

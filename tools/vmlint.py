@@ -10,6 +10,10 @@ finish) at the production assembly shape through ops/vm_analysis.py:
   scheduler hazard (the PR 3 select-then-multiply register blowup class);
 - reports the critical path / width profile / predicted runtime and the
   depth-bound vs width-bound classification ROADMAP item 5 plans against;
+- reports the structural-dedup shape (ISSUE 15): distinct canonical
+  chunk structures vs total chunks at the fused backend's period-aligned
+  window, the dedup ratio, and the predicted cold XLA compile bill with
+  and without dedup;
 - gates against the committed VMLINT_BASELINE.json: any soundness error,
   any hazard, and any pressure/depth scalar grown past the tolerance fails.
 
@@ -39,9 +43,13 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 def _fmt_line(r: dict) -> str:
     p, c = r["pressure"], r["cost"]
+    s = r["structure"]
     # interp prediction: the 280 µs/step register-file model; fused: the
     # ISSUE 13 straight-line lowering model (real per-level widths +
-    # per-level/per-chunk glue — ops/vm_analysis.py FUSED_COST_*)
+    # per-level/per-chunk glue — ops/vm_analysis.py FUSED_COST_*);
+    # structs: the ISSUE 15 dedup shape — distinct canonical chunk
+    # structures / total chunks at the period-aligned window, and the
+    # predicted cold XLA compile bill that buys (vs the per-chunk bill)
     return (
         f"{r['name']:<36} steps={p['sched_steps']:<6} "
         f"crit={c['critical_path']:<6} work={c['work_steps']:<5} "
@@ -49,6 +57,9 @@ def _fmt_line(r: dict) -> str:
         f"regs={p['alloc_regs']:<5} mulutil={c['mul_utilization']:<7} "
         f"pred={c['predicted_row_s']:.2f}s/row "
         f"fused={c['predicted_fused_row_s']:.2f}s/row "
+        f"structs={s['distinct_structs']}/{s['chunks']} "
+        f"({s['dedup_ratio']}x, cold~{s['predicted_cold_s']:.0f}s"
+        f"/{s['predicted_cold_nodedup_s']:.0f}s) "
         f"err={r['errors']} warn={r['warnings']}"
     )
 
