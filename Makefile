@@ -21,7 +21,8 @@ CHEAP_GENERATORS = shuffling bls ssz_generic merkle
         clean_vectors generate_random_tests bench-compare check serve-trace head-bench docs \
         sim-bench sim-smoke serve-bench-mesh mesh-smoke clean rlc-bench \
         finalexp-bench finalexp-smoke native sweep serve-fleet-bench fleet-smoke \
-        latency-bench latency-smoke vmexec-bench vmexec-smoke vmexec-cold-smoke
+        latency-bench latency-smoke vmexec-bench vmexec-smoke vmexec-cold-smoke \
+        proof-bench proof-smoke
 
 # fast default: BLS stubbed except @always_bls, 4-way process-parallel
 # (reference `make test` = pytest -n 4, reference Makefile:100)
@@ -166,6 +167,32 @@ serve-fleet-bench:
 # on failure). Out of tier-1: the workers pay real-backend compiles.
 fleet-smoke:
 	JAX_PLATFORMS=cpu python -m consensus_specs_tpu.serve.fleet_smoke
+
+# light-client proof plane (ISSUE 16): replay 10^4-10^6 simulated
+# read-only clients (CONSENSUS_SPECS_TPU_PROOF_CLIENTS, default 20000)
+# against the content-addressed ProofService — R distinct per-slot
+# artifacts (finality branch + next-sync-committee branch + assembled
+# LightClientUpdate), every one fully verified by the spec's
+# validate_light_client_update AND is_valid_merkle_branch against an
+# independently re-Merkleized root before the timed window, every served
+# request re-checking its finality branch client-side. The JSON line's
+# `proofs` section (verified + proofs/sec + cache hit rate + p99) is
+# state-gated round over round by tools/bench_compare.py ("PROOFS
+# DIVERGED" when a previously-verified shape stops verifying);
+# proofs/sec and hit rate are report-only.
+proof-bench:
+	JAX_PLATFORMS=cpu python bench.py --mode proofs
+
+# proof-plane CI canary (fleet-smoke's read-path sibling): one full
+# artifact served through a ProofService whose sync-committee signature
+# verdict routes through a REAL 2-worker fleet, then verified
+# client-side via validate_light_client_update + is_valid_merkle_branch
+# against an independently re-Merkleized state root (fresh decode_bytes
+# round trip — no warm-cache reuse), with a corrupted-branch negative
+# control; journal dumps to proof_flight.jsonl (CI artifact on
+# failure). Out of tier-1: the workers pay real-backend compiles.
+proof-smoke:
+	JAX_PLATFORMS=cpu python -m consensus_specs_tpu.lightclient.proof_smoke
 
 # mesh convergence canary (CI): one serve flush on a 4-virtual-device
 # mesh through the STRICT verdict-identity gate (mesh == single-device ==
@@ -322,6 +349,7 @@ clean:
 		fleet_flight.jsonl serve_flight.*.jsonl flight_dump.*.jsonl \
 		mesh_flight.*.jsonl finalexp_flight.*.jsonl fleet_flight.*.jsonl \
 		vmexec_flight.jsonl vmexec_flight.*.jsonl \
+		proof_flight.jsonl proof_flight.*.jsonl \
 		*-pid[0-9]*.jsonl
 
 # build the native kernels (csrc/): batched-SHA256 merkleization and the

@@ -98,6 +98,19 @@ heal-to-convergence latency, per-node heads/sec, and the fault mix
 (CONSENSUS_SPECS_TPU_SIM_* env knobs; the `sim` section is gated round
 over round by tools/bench_compare.py — a newly diverging scenario fails).
 
+`--mode proofs` is the light-client read-path bench
+(consensus_specs_tpu/bench/proofs.py): 10^4-10^6 simulated clients
+replayed against the ProofService — R distinct per-slot proof artifacts
+(finality branch + next-sync-committee branch + signed LightClientUpdate,
+every one verified through spec.validate_light_client_update AND
+is_valid_merkle_branch against an independently re-Merkleized root)
+behind the content-addressed (slot, state_root) cache. The JSON line's
+value is proofs/sec; `vs_baseline` is the steady-state cache hit rate
+(the >= 0.99 acceptance bar); the `proofs` section is state-gated round
+over round by tools/bench_compare.py ("PROOFS DIVERGED" when a
+previously-verified shape stops verifying). CONSENSUS_SPECS_TPU_PROOF_*
+env knobs size it.
+
 `--mode head` is the chain-plane bench: a synthetic fork-and-gossip
 replay (consensus_specs_tpu/bench/head_replay.py) through the
 HeadService + proto-array vs the spec-store `get_head` recompute, at
@@ -549,6 +562,22 @@ def main():
         from consensus_specs_tpu.bench.sim_matrix import run_sim_bench
 
         _emit_result(run_sim_bench())
+        return
+
+    if _cli_mode() == "proofs":
+        # light-client read path (ISSUE 16): per-slot proof artifacts
+        # served content-addressed to 10^4+ simulated clients, every one
+        # verified (validate_light_client_update + is_valid_merkle_branch
+        # against a re-Merkleized root). CPU-forced — the thing measured
+        # is proof construction + cache economics, not device math. The
+        # `proofs` section is state-gated round over round by
+        # tools/bench_compare.py ("PROOFS DIVERGED").
+        from consensus_specs_tpu.utils.jax_env import force_cpu
+
+        force_cpu()
+        from consensus_specs_tpu.bench.proofs import run_proofs_bench
+
+        _emit_result(run_proofs_bench())
         return
 
     if _cli_mode() == "latency":

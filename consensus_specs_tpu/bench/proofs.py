@@ -1,0 +1,199 @@
+"""`bench.py --mode proofs` / `make proof-bench`: the read-path bench.
+
+Replays 10^4-10^6 simulated light clients against the proof plane: R
+distinct per-slot artifacts (R = CONSENSUS_SPECS_TPU_PROOF_SLOTS head
+slots in one altair ``ProofWorld``) behind one ``ProofService``, hit by
+N = CONSENSUS_SPECS_TPU_PROOF_CLIENTS client requests round-robin over
+the slots from CONSENSUS_SPECS_TPU_PROOF_WORKERS request threads. The
+content address ``(slot, state_root)`` makes exactly R requests builds
+and every other request a cache hit or in-flight join, so the steady-
+state hit rate is (N - R) / N — the >= 0.99 acceptance bar at N >= 10^4.
+
+Every artifact is FULLY verified before the timed window: the spec's
+``validate_light_client_update`` (both branches, period math, and the
+sync-committee FastAggregateVerify), the combined multiproof, and the
+finality branch against an independently re-Merkleized state root
+(fresh ``decode_bytes`` round trip — no warm-cache reuse on the verify
+side). Inside the window every request still pays the client-side
+``is_valid_merkle_branch`` finality check on the artifact it received —
+served bytes are never trusted unchecked.
+
+The signature verdict routes through the real ``VerificationService``
+(CONSENSUS_SPECS_TPU_PROOF_BACKEND: "oracle" = pure-python pairing per
+update — real crypto, no XLA compiles; "verdict" = the crypto-free
+``VerdictBackend`` for quick runs). The ``proofs`` JSON section
+(per-shape ``verified`` + proofs/sec + hit rate + p99) is what
+``tools/bench_compare.py`` state-gates round over round ("PROOFS
+DIVERGED" when a previously-verified shape stops verifying).
+"""
+import os
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+CLIENTS_ENV = "CONSENSUS_SPECS_TPU_PROOF_CLIENTS"
+SLOTS_ENV = "CONSENSUS_SPECS_TPU_PROOF_SLOTS"
+WORKERS_ENV = "CONSENSUS_SPECS_TPU_PROOF_WORKERS"
+BACKEND_ENV = "CONSENSUS_SPECS_TPU_PROOF_BACKEND"
+
+
+class _OracleBackend:
+    """Per-item pure-python FastAggregateVerify — real pairings with no
+    XLA compile bill (the PR 12 tier-budget pattern); only the R distinct
+    artifact builds ever reach it."""
+
+    def __init__(self):
+        self.calls = 0
+        self.items = 0
+
+    def batch_fast_aggregate_verify(self, pubkey_sets, messages, signatures):
+        from ..utils import bls
+
+        self.calls += 1
+        self.items += len(signatures)
+        return [
+            bool(bls.FastAggregateVerify(list(pks), bytes(msg), bytes(sig)))
+            for pks, msg, sig in zip(pubkey_sets, messages, signatures)
+        ]
+
+    def batch_aggregate_verify(self, pubkey_sets, message_sets, signatures):
+        from ..utils import bls
+
+        self.calls += 1
+        self.items += len(signatures)
+        return [
+            bool(bls.AggregateVerify(list(pks), [bytes(m) for m in msgs],
+                                     bytes(sig)))
+            for pks, msgs, sig in zip(pubkey_sets, message_sets, signatures)
+        ]
+
+
+def run_proofs_bench() -> dict:
+    """Run the proof-serving replay; returns bench.py's result dict."""
+    from ..builder import build_spec_module
+    from ..lightclient.proof_tree import (
+        ProofWorld, build_update_artifact, floorlog2, subtree_index,
+        verify_artifact,
+    )
+    from ..lightclient.serve_proofs import ProofService
+    from ..obs import latency
+    from ..ops import profiling
+    from ..serve.service import VerificationService
+
+    profiling.reset()
+    latency.reset()
+
+    n_clients = int(os.environ.get(CLIENTS_ENV, "20000"))
+    n_slots = max(1, int(os.environ.get(SLOTS_ENV, "8")))
+    n_workers = max(1, int(os.environ.get(WORKERS_ENV, "4")))
+    backend_kind = os.environ.get(BACKEND_ENV, "oracle").strip() or "oracle"
+
+    spec = build_spec_module("altair", "minimal")
+    world = ProofWorld(spec)
+    if backend_kind == "verdict":
+        from ..serve.load import VerdictBackend
+
+        backend = VerdictBackend()
+    else:
+        backend = _OracleBackend()
+    verifier = VerificationService(backend, max_batch=8, max_wait_ms=1.0)
+    service = ProofService(verifier=verifier)
+
+    head_slots = [world.finalized_slot + 1 + i for i in range(n_slots)]
+    states = {s: world.head_state(s) for s in head_slots}
+    roots = {s: bytes(states[s].hash_tree_root()) for s in head_slots}
+
+    def build(slot):
+        return build_update_artifact(
+            spec, states[slot], world.finalized_state,
+            genesis_validators_root=world.genesis_validators_root,
+            sign=world.sign)
+
+    all_verified = True
+    try:
+        # -- warm + full verification of every distinct artifact ----------
+        t_build = time.perf_counter()
+        for s in head_slots:
+            artifact = service.serve(s, roots[s], lambda s=s: build(s))
+            # service-side verdict (VerificationService BLS fast path)
+            all_verified &= artifact.verified is True
+            # client-side: the whole spec check against an independently
+            # re-Merkleized root (fresh deserialization, cold caches)
+            fresh = spec.BeaconState.decode_bytes(states[s].encode_bytes())
+            verify_artifact(
+                spec, artifact, world.snapshot,
+                world.genesis_validators_root,
+                state_root=bytes(fresh.hash_tree_root()))
+        build_s = time.perf_counter() - t_build
+
+        # -- the timed client replay --------------------------------------
+        def one_request(i: int) -> bool:
+            slot = head_slots[i % n_slots]
+            artifact = service.serve(slot, roots[slot],
+                                     lambda: build(slot))
+            # every served proof is checked, not trusted: the finality
+            # branch must re-hash to the requested state root
+            g = artifact.finality_gindex
+            ok = artifact.verified is True and spec.is_valid_merkle_branch(
+                spec.Root(artifact.finalized_root),
+                [spec.Bytes32(b) for b in artifact.finality_branch],
+                floorlog2(g), subtree_index(g),
+                spec.Root(bytes(roots[slot])))
+            return bool(ok)
+
+        t0 = time.perf_counter()
+        if n_workers == 1:
+            checked = sum(one_request(i) for i in range(n_clients))
+        else:
+            with ThreadPoolExecutor(max_workers=n_workers) as pool:
+                checked = sum(pool.map(one_request, range(n_clients),
+                                       chunksize=256))
+        elapsed = time.perf_counter() - t0
+        all_verified &= checked == n_clients
+    finally:
+        verifier.close(timeout=30)
+
+    pps = n_clients / elapsed if elapsed > 0 else 0.0
+    hit_rate = service.metrics.hit_rate
+    service.export_gauges()
+    lat = latency.snapshot()
+    serve_summary = lat.get(latency.stage_label("proof_serve"), {})
+    p99_ms = float(serve_summary.get("p99_ms", 0.0))
+
+    shape = f"clients={n_clients}"
+    proofs_section = {
+        shape: {
+            "verified": bool(all_verified),
+            "proofs_per_sec": round(pps, 2),
+            "hit_rate": round(hit_rate, 6),
+            "p99_ms": round(p99_ms, 4),
+            "clients": n_clients,
+            "slots": n_slots,
+            "workers": n_workers,
+            "backend": backend_kind,
+        }
+    }
+    return dict(
+        metric="light-client proofs served/sec",
+        value=round(pps, 2),
+        # the acceptance bar: content-addressed steady-state hit rate
+        vs_baseline=round(hit_rate, 4),
+        unit="proofs/sec",
+        mode="proofs",
+        platform="cpu",
+        clients=n_clients,
+        slots=n_slots,
+        workers=n_workers,
+        backend=backend_kind,
+        distinct_artifacts=n_slots,
+        verified=bool(all_verified),
+        checked_requests=int(checked),
+        hit_rate=round(hit_rate, 6),
+        p99_ms=round(p99_ms, 4),
+        build_s=round(build_s, 3),
+        elapsed_s=round(elapsed, 3),
+        proofs=proofs_section,
+        per_mode_best={f"proofs[{shape}]": round(pps, 2)},
+        stage_latency=lat,
+        service=service.snapshot(),
+        profile=profiling.summary(),
+    )

@@ -46,7 +46,7 @@ DEFAULT_RING = 4096
 DEFAULT_DUMP = "flight_dump.jsonl"
 
 # stable plane -> chrome tid mapping (new planes append)
-PLANES = ("serve", "chain", "vm", "fleet")
+PLANES = ("serve", "chain", "vm", "fleet", "lightclient")
 
 # set by the fleet router in every worker process it spawns: dump paths
 # get a `.{label}-pid{pid}` suffix so N workers (and the router) sharing

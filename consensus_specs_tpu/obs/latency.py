@@ -54,6 +54,9 @@ GOSSIP_TO_HEAD_LABEL = "latency.gossip_to_head"
 STAGES: Tuple[str, ...] = (
     "ingress", "queue_wait", "prep", "device", "combine", "finalize",
     "validate", "sig_wait", "apply", "sweep", "head",
+    # the light-client proof plane (ISSUE 16): artifact build, signature
+    # verdict wait, and the full serve() request (hit or build)
+    "proof_build", "proof_verify", "proof_serve",
 )
 
 # what a QUEUED serve item still has ahead of it — the stages whose

@@ -166,6 +166,24 @@ GAUGES: Dict[str, str] = {
     "slo.worst_burn_rate": "highest burn rate across objectives and "
                            "windows (1.0 = consuming error budget exactly "
                            "at the sustainable rate)",
+    "lightclient.proofs_served": "proof requests answered by the "
+                                 "ProofService (hit, in-flight join, or "
+                                 "fresh build)",
+    "lightclient.proof_builds": "per-slot proof artifacts actually "
+                                "materialized (cache misses that owned "
+                                "the build)",
+    "lightclient.cache_hit_rate": "share of served proofs answered "
+                                  "without a rebuild (cache hits + "
+                                  "in-flight joins) / served",
+    "lightclient.inflight_joins": "proof requests that joined a "
+                                  "concurrent in-flight build instead of "
+                                  "duplicating it",
+    "lightclient.updates_verified": "sync-committee signatures on served "
+                                    "updates verified True through the "
+                                    "VerificationService fast path",
+    "lightclient.verify_failures": "sync-committee signature verdicts "
+                                   "that came back False (the artifact "
+                                   "is still served, flagged unverified)",
 }
 
 STATS: Dict[str, str] = {
@@ -211,7 +229,8 @@ DYNAMIC_PREFIXES: Dict[str, tuple] = {
                                   "over the fixed obs/latency.py stage "
                                   "set (ingress/queue_wait/prep/device/"
                                   "combine/finalize/validate/sig_wait/"
-                                  "apply/sweep/head)"),
+                                  "apply/sweep/head plus the proof plane's "
+                                  "proof_build/proof_verify/proof_serve)"),
     # node-labelled instance families (simnet: N HeadService /
     # VerificationService instances in ONE process — the bare chain.* /
     # serve.* gauges would collide, so each instance exports under
@@ -224,6 +243,12 @@ DYNAMIC_PREFIXES: Dict[str, tuple] = {
                              "instance (simnet) runs, labelled "
                              "serve[<node>].<name> — same names as the "
                              "serve.* family"),
+    "lightclient[": ("lightclient_node", "per-node light-client proof-"
+                                         "plane metrics from multi-"
+                                         "instance (simnet) runs, "
+                                         "labelled lightclient[<node>]."
+                                         "<name> — same names as the "
+                                         "lightclient.* family"),
 }
 
 

@@ -25,13 +25,15 @@ from typing import Optional, Set
 
 from ..chain import HeadService
 from ..chain.metrics import ChainMetrics
+from ..lightclient.proof_tree import build_head_proof, verify_head_proof
+from ..lightclient.serve_proofs import ProofService
 from ..obs import latency
 from ..obs.flight import FlightRecorder
 from ..serve.load import VerdictBackend
 from ..serve.service import VerificationService
 from .fabric import Message
 
-__all__ = ["SimNode"]
+__all__ = ["SimNode", "LightClientNode"]
 
 
 class SimNode:
@@ -84,6 +86,10 @@ class SimNode:
         # moment its parent lands (real clients hold an identical queue)
         self._orphan_blocks = {}  # parent root bytes -> [block, ...]
         self.orphaned_blocks = 0
+        # the light-client proof plane (ISSUE 16): lazy — a node pays for
+        # a ProofService only once a client actually fetches from it
+        self._proofs: Optional[ProofService] = None
+        self._state_root: Optional[bytes] = None
 
     # -- clock ---------------------------------------------------------------
 
@@ -143,6 +149,36 @@ class SimNode:
     def get_head(self) -> bytes:
         return bytes(self.head.get_head())
 
+    # -- light-client proof serving ------------------------------------------
+
+    @property
+    def proofs(self) -> ProofService:
+        if self._proofs is None:
+            self._proofs = ProofService(
+                node=self.name, recorder=self.recorder)
+        return self._proofs
+
+    def serve_head_proof(self) -> dict:
+        """One light-client response: the node's current head (root +
+        block) plus the content-addressed proof artifact for it. Sim
+        blocks carry crafted state roots and every block maps to the one
+        shared anchor state, so the artifact's finality branch is built
+        over (and verified against) that state — the weak-subjectivity
+        checkpoint every sim light client trusts. Keyed by
+        ``(head_slot, state_root)``: repeated fetches at one head slot
+        are cache hits, exactly the production content-address rule."""
+        head_root = self.get_head()
+        block = self.head.store.blocks[self.spec.Root(head_root)]
+        head_slot = int(block.slot)
+        if self._state_root is None:
+            self._state_root = bytes(self._shared_state.hash_tree_root())
+        artifact = self.proofs.serve(
+            head_slot, self._state_root,
+            lambda: build_head_proof(self.spec, self._shared_state))
+        return {"node": self.name, "head_root": head_root,
+                "head_slot": head_slot, "block": block,
+                "artifact": artifact}
+
     def snapshot(self) -> dict:
         snap = self.head.metrics.snapshot()
         return {
@@ -160,7 +196,92 @@ class SimNode:
             "deadline_flushes": self.service.metrics.deadline_flushes,
             "duplicates": self.duplicates,
             "backend_calls": self.backend.calls,
+            "proofs": (self._proofs.snapshot()
+                       if self._proofs is not None else None),
         }
 
     def close(self) -> None:
         self.service.close(timeout=30)
+
+
+class LightClientNode:
+    """The simnet ``light_client`` node kind (index ``i``, name ``c<i>``):
+    a read-only participant that never gossips or votes — it fetches head
+    proofs from full nodes and verifies every byte against its own
+    trusted weak-subjectivity checkpoint (the anchor state root), the sim
+    mirror of a ``validate_light_client_update`` store:
+
+    - the served state root must BE the trusted root (the client accepts
+      no other state commitment),
+    - the finality branch must re-hash to it (real SHA-256 through
+      ``spec.is_valid_merkle_branch`` — no served intermediate reuse),
+    - the served head root must equal ``hash_tree_root`` of the served
+      block (re-hashed locally), and
+    - accepted heads advance monotonically (the mirror of
+      ``validate_light_client_update``'s slot assertion; a stale proof
+      from a lagging node is rejected, not an error).
+
+    Any cryptographic mismatch is a ``failure`` — the convergence gate
+    fails the scenario on a single one.
+    """
+
+    def __init__(self, index: int, spec, anchor_state, *, sim_clock=None,
+                 flight_capacity: int = 1024):
+        self.index = index
+        self.name = f"c{index}"
+        self.spec = spec
+        self.trusted_state_root = bytes(anchor_state.hash_tree_root())
+        self.recorder = FlightRecorder(
+            capacity=flight_capacity, node=self.name,
+            clock=sim_clock if sim_clock is not None else (lambda: 0.0))
+        self.head_root = b""
+        self.head_slot = -1
+        self.last_server = ""
+        self.fetches = 0
+        self.verified = 0
+        self.failures = 0
+        self.rejected_stale = 0
+
+    def fetch(self, server: SimNode) -> bool:
+        """Fetch + verify one head proof from ``server``; True when the
+        proof verified AND advanced (or re-confirmed) the client's head."""
+        self.fetches += 1
+        resp = server.serve_head_proof()
+        try:
+            verify_head_proof(self.spec, resp["artifact"],
+                              self.trusted_state_root)
+            served_root = bytes(resp["head_root"])
+            assert bytes(self.spec.hash_tree_root(resp["block"])) == \
+                served_root, "served head root does not re-hash to block"
+            assert int(resp["block"].slot) == int(resp["head_slot"]), \
+                "served head slot does not match block"
+        except AssertionError as exc:
+            self.failures += 1
+            self.recorder.note("lightclient", "proof_reject",
+                               server=server.name, error=str(exc))
+            return False
+        if int(resp["head_slot"]) < self.head_slot:
+            self.rejected_stale += 1
+            self.recorder.note("lightclient", "proof_stale",
+                               server=server.name,
+                               slot=int(resp["head_slot"]),
+                               have=self.head_slot)
+            return False
+        self.verified += 1
+        self.head_root = served_root
+        self.head_slot = int(resp["head_slot"])
+        self.last_server = server.name
+        self.recorder.note("lightclient", "proof_accept",
+                           server=server.name, slot=self.head_slot)
+        return True
+
+    def snapshot(self) -> dict:
+        return {
+            "fetches": self.fetches,
+            "verified": self.verified,
+            "failures": self.failures,
+            "rejected_stale": self.rejected_stale,
+            "head_slot": self.head_slot,
+            "head": self.head_root.hex()[:16],
+            "last_server": self.last_server,
+        }

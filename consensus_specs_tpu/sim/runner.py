@@ -28,9 +28,13 @@ One scenario run has three phases:
    node must know the same block set, hold identical latest-message
    tables, and answer the same ``get_head`` — and that head must be
    bit-identical to ``spec.get_head`` recomputed BOTH on each node's own
-   store and on a union store rebuilt from scratch. The same scripted
-   run under the same seed replays the identical event sequence
-   (``digest`` pins it).
+   store and on a union store rebuilt from scratch. When the scenario
+   runs ``light_clients`` (default 2), the gate grows a proof-plane
+   layer: every client must have verified at least one served head
+   proof, report zero verification failures, and sit at the agreed
+   head — a lying or diverged proof server is a convergence failure,
+   not just a metric. The same scripted run under the same seed replays
+   the identical event sequence (``digest`` pins it).
 """
 import hashlib
 import os
@@ -42,7 +46,7 @@ from typing import Dict, List, Optional, Tuple
 from ..serve.load import BAD_SIGNATURE, plan_gossip_faults
 from . import adversary
 from .fabric import EventQueue, Fabric, Message
-from .node import SimNode
+from .node import LightClientNode, SimNode
 from .scenarios import Scenario
 
 __all__ = [
@@ -93,6 +97,15 @@ class ScenarioReport:
     per_node: Dict[str, dict] = field(default_factory=dict)
     heads_per_sec_min: float = 0.0
     heads_per_sec_mean: float = 0.0
+    # the light-client proof plane (ISSUE 16): read-only clients fetching
+    # head proofs at heal/sync points + one final round; their verified
+    # proof-backed heads are convergence-gated (layer 5)
+    light_clients: int = 0
+    proofs_served: int = 0
+    proofs_verified: int = 0
+    proof_failures: int = 0
+    proof_cache_hit_rate: float = 0.0
+    per_client: Dict[str, dict] = field(default_factory=dict)
     # deliveries observed while honest heads DISAGREED — evidence the
     # scenario genuinely disturbed the network before it converged
     diverged_samples: int = 0
@@ -101,6 +114,7 @@ class ScenarioReport:
     def to_dict(self) -> dict:
         out = dict(self.__dict__)
         out["per_node"] = dict(self.per_node)
+        out["per_client"] = dict(self.per_client)
         out["events"] = dict(self.events)
         return out
 
@@ -316,7 +330,8 @@ def run_scenario(scenario: Scenario, *, spec=None, anchor_state=None,
                  query_rounds: int = 512,
                  backend_factory=None,
                  service_kwargs: Optional[dict] = None,
-                 head_kwargs: Optional[dict] = None) -> ScenarioReport:
+                 head_kwargs: Optional[dict] = None,
+                 light_clients: Optional[int] = None) -> ScenarioReport:
     """Run one scenario end to end and gate it. ``strict`` raises
     :class:`SimDivergence` on any convergence failure; bench mode passes
     ``strict=False`` and reads ``report.converged``/``report.error``.
@@ -325,7 +340,9 @@ def run_scenario(scenario: Scenario, *, spec=None, anchor_state=None,
     ``head_kwargs`` override every node's VerificationService /
     HeadService knobs (the latency bench's deadline-flush and
     speculative-apply A/B runs) — the scenario script and the gate are
-    untouched by either."""
+    untouched by either. ``light_clients`` overrides the scenario's
+    read-only light-client count (they fetch proofs OUTSIDE the event
+    queue, so the determinism digest is unchanged)."""
     from ..utils import bls
 
     if spec is None:
@@ -365,6 +382,24 @@ def run_scenario(scenario: Scenario, *, spec=None, anchor_state=None,
                 backend=(backend_factory(f"n{i}")
                          if backend_factory is not None else None),
                 service_kwargs=service_kwargs, head_kwargs=head_kwargs))
+        n_clients = (scenario.light_clients if light_clients is None
+                     else light_clients)
+        clients = [
+            LightClientNode(i, spec, anchor_state,
+                            sim_clock=lambda: clock_box["now"])
+            for i in range(n_clients)]
+        fetch_rounds = [0]
+
+        def client_fetch_round() -> None:
+            """Every light client fetches from a deterministic full node
+            (rotating per round). Pure reads — no queue events, so the
+            event-stream digest is untouched."""
+            if not clients:
+                return
+            r = fetch_rounds[0]
+            fetch_rounds[0] += 1
+            for client in clients:
+                client.fetch(sim_nodes[(client.index + r) % len(sim_nodes)])
 
         # -- schedule ---------------------------------------------------------
         for t, origin, msg in script.block_publishes:
@@ -444,8 +479,10 @@ def run_scenario(scenario: Scenario, *, spec=None, anchor_state=None,
                 fabric.heal()
                 last_heal = ev.time
                 _sync(queue, fabric, sim_nodes, ev.time)
+                client_fetch_round()
             elif ev.kind == "sync":
                 _sync(queue, fabric, sim_nodes, ev.time)
+                client_fetch_round()
 
         # final ticks: unlock any time-gated deferrals and settle clocks
         # (past the last processed event — sync-chained deliveries can
@@ -455,6 +492,9 @@ def run_scenario(scenario: Scenario, *, spec=None, anchor_state=None,
         for node in sim_nodes:
             node.advance_clock(t_final)
         samples.append((t_final, heads_equal()))
+        # the final proof round: with heads settled, every client's
+        # proof-backed head must land on THE head (gate layer 5)
+        client_fetch_round()
 
         # -- gate -------------------------------------------------------------
         report = ScenarioReport(
@@ -477,7 +517,7 @@ def run_scenario(scenario: Scenario, *, spec=None, anchor_state=None,
         error = None
         try:
             _convergence_gate(spec, anchor_state, anchor_block, sim_nodes,
-                              script)
+                              script, clients)
         except SimDivergence as exc:
             error = str(exc)
 
@@ -510,6 +550,25 @@ def run_scenario(scenario: Scenario, *, spec=None, anchor_state=None,
         report.heads_per_sec_min = round(min(rates), 2)
         report.heads_per_sec_mean = round(sum(rates) / len(rates), 2)
 
+        # proof-plane ledger: per-client verdict counters + the serving
+        # side's cache economics aggregated across nodes
+        report.light_clients = len(clients)
+        for client in clients:
+            report.per_client[client.name] = client.snapshot()
+        report.proofs_verified = sum(c.verified for c in clients)
+        report.proof_failures = sum(c.failures for c in clients)
+        served = hits = joins = 0
+        for node in sim_nodes:
+            if node._proofs is None:
+                continue
+            m = node._proofs.metrics
+            served += m.served
+            hits += m.cache_hits
+            joins += m.inflight_joins
+        report.proofs_served = served
+        report.proof_cache_hit_rate = round(
+            (hits + joins) / served, 4) if served else 0.0
+
         head0 = sim_nodes[0].get_head()
         report.head = head0.hex()[:16]
         report.head_slot = sim_nodes[0].head.head_slot
@@ -519,7 +578,7 @@ def run_scenario(scenario: Scenario, *, spec=None, anchor_state=None,
         report.error = error
 
         if flight_dir:
-            _dump_flights(flight_dir, scenario.name, sim_nodes)
+            _dump_flights(flight_dir, scenario.name, sim_nodes, clients)
         if error is not None and strict:
             raise SimDivergence(
                 f"scenario {scenario.name!r} (nodes={scenario.nodes}, "
@@ -555,9 +614,10 @@ def _sync(queue: EventQueue, fabric: Fabric, sim_nodes: List[SimNode],
 
 
 def _dump_flights(flight_dir: str, scenario_name: str,
-                  sim_nodes: List[SimNode]) -> None:
+                  sim_nodes: List[SimNode],
+                  clients: List[LightClientNode] = ()) -> None:
     os.makedirs(flight_dir, exist_ok=True)
-    for node in sim_nodes:
+    for node in list(sim_nodes) + list(clients):
         node.recorder.dump(
             os.path.join(flight_dir,
                          f"sim_flight_{scenario_name}_{node.name}.jsonl"),
@@ -565,12 +625,14 @@ def _dump_flights(flight_dir: str, scenario_name: str,
 
 
 def _convergence_gate(spec, anchor_state, anchor_block,
-                      sim_nodes: List[SimNode], script: _Script) -> None:
-    """The differential claim, in four layers (any failure raises with
+                      sim_nodes: List[SimNode], script: _Script,
+                      clients: List[LightClientNode] = ()) -> None:
+    """The differential claim, in five layers (any failure raises with
     the cross-node diff): identical block sets, identical latest-message
-    tables, identical heads, and that head equal to ``spec.get_head``
+    tables, identical heads, that head equal to ``spec.get_head``
     recomputed on each node's own store AND on a from-scratch union
-    store."""
+    store, and every light client's proof-backed head equal to it with
+    zero proof-verification failures."""
     # 1. every honest node knows the same blocks
     sets = [frozenset(bytes(r) for r in n.head.store.blocks)
             for n in sim_nodes]
@@ -640,3 +702,21 @@ def _convergence_gate(spec, anchor_state, anchor_block,
         raise SimDivergence(
             "long-range attack succeeded: the agreed head is on the "
             "adversary's private fork")
+
+    # 5. the proof plane: every light client verified served proofs
+    # (zero cryptographic rejections) and its proof-backed head is THE
+    # head — proof correctness is convergence-gated, not best-effort
+    for client in clients:
+        if client.failures:
+            raise SimDivergence(
+                f"light client {client.name} rejected {client.failures} "
+                f"served proof(s) as cryptographically invalid")
+        if not client.verified:
+            raise SimDivergence(
+                f"light client {client.name} never verified a proof "
+                f"({client.fetches} fetches)")
+        if bytes(client.head_root) != heads[0]:
+            raise SimDivergence(
+                f"light-client head divergence at {client.name}: "
+                f"proof-backed head {client.head_root.hex()[:12]} != "
+                f"{heads[0].hex()[:12]}")

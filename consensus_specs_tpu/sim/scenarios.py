@@ -50,6 +50,10 @@ class Scenario:
     censor_rate: float = 0.0
     # adversary extras
     long_range_fork: int = 0  # private-fork length released late
+    # read-only light clients fetching + verifying head proofs from full
+    # nodes (sim/node.py::LightClientNode); their proof correctness is a
+    # convergence-gated property on every scenario
+    light_clients: int = 2
 
     def with_nodes(self, nodes: int) -> "Scenario":
         """The same scenario rescaled to ``nodes`` participants. Partition

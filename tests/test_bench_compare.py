@@ -795,3 +795,114 @@ def test_vmexec_extract_shapes(bc):
         "ok": True, "fused_ms_row": 46.3, "interp_ms_row": 255.0}}
     assert bc.extract_vmexec({"parsed": {"error": "boom"}}) == {}
     assert bc.extract_vmexec({"parsed": _parsed(1.0)}) == {}
+
+
+# -- the light-client proofs state gate (ISSUE 16) ---------------------------
+
+
+def _proofs_parsed(value, shapes, **extra):
+    """A `--mode proofs` round: shapes maps "clients=<N>" ->
+    (verified, proofs_per_sec, hit_rate, p99_ms)."""
+    section = {
+        name: {"verified": ver, "proofs_per_sec": pps, "hit_rate": hit,
+               "p99_ms": p99, "clients": 20000, "slots": 8, "workers": 4,
+               "backend": "oracle"}
+        for name, (ver, pps, hit, p99) in shapes.items()
+    }
+    return _parsed(value, mode="proofs", n=None, k=None,
+                   proofs=section, **extra)
+
+
+def test_proofs_newly_unverified_shape_fails(tmp_path, bc, capsys):
+    """The proofs gate: a client-count shape whose every served artifact
+    verified (validate_light_client_update + is_valid_merkle_branch
+    against a re-Merkleized root) last round and stops verifying in the
+    newest fails outright — "PROOFS DIVERGED", the sim-gate mirror for
+    the read path."""
+    _write_round(tmp_path, 1, _proofs_parsed(
+        16000.0, {"clients=20000": (True, 16000.0, 0.9996, 0.03)}))
+    _write_round(tmp_path, 2, _proofs_parsed(
+        16000.0, {"clients=20000": (False, 16500.0, 0.9996, 0.03)}))
+    assert bc.main(["--dir", str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "cpu:proofs:clients=20000" in out and "PROOFS DIVERGED" in out
+
+
+def test_proofs_throughput_and_hit_rate_are_report_only(tmp_path, bc,
+                                                        capsys):
+    """proofs/sec, cache hit rate, and p99 movement within verified never
+    fail the proofs gate on their own (serve throughput on shared CPU
+    hosts jitters; the page-worthy event is the verdict flipping). The
+    headline `value` still rides the ordinary throughput gate."""
+    _write_round(tmp_path, 1, _proofs_parsed(
+        16000.0, {"clients=20000": (True, 16000.0, 0.9996, 0.03)}))
+    _write_round(tmp_path, 2, _proofs_parsed(
+        15000.0, {"clients=20000": (True, 15000.0, 0.52, 9.0)}))
+    assert bc.main(["--dir", str(tmp_path)]) == 0
+    assert "cpu:proofs:clients=20000" in capsys.readouterr().out
+
+
+def test_proofs_still_unverified_is_not_a_new_failure(tmp_path, bc):
+    """verified False -> False: the flip round already failed once; a
+    permanently-red shape must not wedge every future round."""
+    _write_round(tmp_path, 1, _proofs_parsed(
+        16000.0, {"clients=1000": (False, 16000.0, 0.99, 0.03)}))
+    _write_round(tmp_path, 2, _proofs_parsed(
+        16000.0, {"clients=1000": (False, 16000.0, 0.99, 0.03)}))
+    assert bc.main(["--dir", str(tmp_path)]) == 0
+
+
+def test_proofs_keys_join_without_common_throughput_keys(tmp_path, bc,
+                                                         capsys):
+    """Shared proofs keys are comparables in their own right (the
+    SLO/sim/mesh/fleet rule): disjoint throughput shapes must still gate
+    a verified -> unverified transition instead of skipping."""
+    _write_round(tmp_path, 1, _parsed(
+        1000.0, mode="head", n=None, k=None, blocks=1024,
+        proofs={"clients=20000": {"verified": True,
+                                  "proofs_per_sec": 16000.0,
+                                  "hit_rate": 0.9996, "p99_ms": 0.03}}))
+    _write_round(tmp_path, 2, _parsed(
+        900.0, mode="head", n=None, k=None, blocks=128,
+        proofs={"clients=20000": {"verified": False,
+                                  "proofs_per_sec": 16000.0,
+                                  "hit_rate": 0.9996, "p99_ms": 0.03}}))
+    assert bc.main(["--dir", str(tmp_path)]) == 1
+    assert "PROOFS DIVERGED" in capsys.readouterr().out
+
+
+def test_proofs_only_previous_round_is_a_usable_baseline(tmp_path, bc,
+                                                         capsys):
+    """A prior round whose headline value is unusable but whose proofs
+    section recorded verification state still baselines the proofs gate —
+    the walk must not skip past it to 'no earlier round'."""
+    broken = _proofs_parsed(
+        16000.0, {"clients=20000": (True, 16000.0, 0.9996, 0.03)})
+    broken["value"] = 0.0  # headline unusable, proofs section intact
+    _write_round(tmp_path, 1, broken)
+    _write_round(tmp_path, 2, _proofs_parsed(
+        16000.0, {"clients=20000": (False, 16000.0, 0.9996, 0.03)}))
+    assert bc.main(["--dir", str(tmp_path)]) == 1
+    assert "PROOFS DIVERGED" in capsys.readouterr().out
+
+
+def test_proofs_new_shapes_are_not_gated_until_seen(tmp_path, bc):
+    """A client-count shape appearing for the first time has no baseline
+    — report-only this round, gated from the next."""
+    _write_round(tmp_path, 1, _proofs_parsed(
+        16000.0, {"clients=20000": (True, 16000.0, 0.9996, 0.03)}))
+    _write_round(tmp_path, 2, _proofs_parsed(
+        16000.0, {"clients=20000": (True, 16000.0, 0.9996, 0.03),
+                  "clients=1000000": (False, 0.0, 0.0, 0.0)}))
+    assert bc.main(["--dir", str(tmp_path)]) == 0
+
+
+def test_proofs_extract_shapes(bc):
+    doc = {"parsed": _proofs_parsed(
+        16000.0, {"clients=20000": (True, 16320.01, 0.9996, 0.028)})}
+    assert bc.extract_proofs(doc) == {
+        "cpu:proofs:clients=20000": {
+            "ok": True, "proofs_per_sec": 16320.01, "hit_rate": 0.9996,
+            "p99_ms": 0.028}}
+    assert bc.extract_proofs({"parsed": {"error": "boom"}}) == {}
+    assert bc.extract_proofs({"parsed": _parsed(300.0)}) == {}

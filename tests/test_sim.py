@@ -140,7 +140,8 @@ def test_node_labelled_metrics_published(world):
 
 
 def test_flight_journals_per_node(world, tmp_path):
-    """One JSONL journal per node, node-stamped, on the simulated clock."""
+    """One JSONL journal per node AND per light client, stamped, on the
+    simulated clock."""
     import json
 
     spec, anchor_state, anchor_block = world
@@ -150,11 +151,15 @@ def test_flight_journals_per_node(world, tmp_path):
         flight_dir=str(tmp_path))
     files = sorted(p.name for p in tmp_path.iterdir())
     assert files == [
+        f"sim_flight_withheld_orphans_c{i}.jsonl"
+        for i in range(report.light_clients)
+    ] + [
         f"sim_flight_withheld_orphans_n{i}.jsonl"
         for i in range(report.nodes)
     ]
+    first_node = "sim_flight_withheld_orphans_n0.jsonl"
     lines = [json.loads(ln) for ln in
-             (tmp_path / files[0]).read_text().splitlines()]
+             (tmp_path / first_node).read_text().splitlines()]
     header, events = lines[0], lines[1:]
     assert header["node"] == "n0" and header["events"] > 0
     kinds = {e["kind"] for e in events}

@@ -165,6 +165,77 @@ def test_union_nodes():
     assert verify_merkle_proof(d.tag.hash_tree_root(), proof, g_tag, root)
 
 
+def test_cold_cache_proofs_bit_identical_to_warm(monkeypatch):
+    """ISSUE 16 satellite: the proof builders read interior nodes out of
+    the incremental `_ChunkTree` layer caches when a series has hashed
+    before, and fall back to explicit re-merkleization (`_chunk_layer` +
+    `_subtree_node`) when it hasn't. The two routes must be bit-identical
+    — a freshly deserialized view (cold caches) must serve the exact
+    bytes a long-lived warm view serves. Forcing `_cached_tree` to None
+    disables the cache route outright, so every node goes through the
+    fallback."""
+    from consensus_specs_tpu.utils.ssz import proofs as proofs_mod
+
+    rng = random.Random(11)
+    d = make_demo(rng)
+    root = bytes(d.hash_tree_root())  # warms every series cache
+    paths = [
+        ("slot",), ("pairs", 17), ("pairs", 30, "y"), ("roots", 63),
+        ("nums", 10), ("bits", 300), ("nums", "__len__"),
+    ]
+    gindices = [get_generalized_index(Demo, *p) for p in paths]
+
+    warm_branches = [build_proof(d, *p) for p in paths]
+    warm_leaves, warm_multi = build_multiproof(d, gindices)
+
+    monkeypatch.setattr(proofs_mod, "_cached_tree", lambda view: None)
+    cold_branches = [build_proof(d, *p) for p in paths]
+    cold_leaves, cold_multi = build_multiproof(d, gindices)
+    monkeypatch.undo()
+
+    for path, warm, cold in zip(paths, warm_branches, cold_branches):
+        assert [bytes(x) for x in warm] == [bytes(x) for x in cold], path
+    assert [bytes(x) for x in warm_leaves] == [bytes(x) for x in cold_leaves]
+    assert [bytes(x) for x in warm_multi] == [bytes(x) for x in cold_multi]
+    # both routes verify against the one root
+    assert verify_merkle_multiproof(cold_leaves, cold_multi, gindices, root)
+
+
+def test_fresh_deserialization_proofs_match_warm_view():
+    """The decode_bytes round trip — a view whose layer caches were never
+    warmed by incremental updates, the state every proof-serving replica
+    restarts into — must produce bit-identical branches to the long-lived
+    view it was serialized from, over the light-client gindices (105:
+    finalized_checkpoint.root, 55: next_sync_committee)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from consensus_specs_tpu.builder import build_spec_module
+
+    spec = build_spec_module("altair", "minimal")
+    warm = spec.BeaconState()
+    warm.slot = spec.Slot(77)
+    warm.finalized_checkpoint.epoch = spec.Epoch(4)
+    warm.finalized_checkpoint.root = spec.Root(b"\x17" * 32)
+    root = bytes(warm.hash_tree_root())
+
+    cold = spec.BeaconState.decode_bytes(warm.encode_bytes())
+    assert bytes(cold.hash_tree_root()) == root
+
+    g_fin = get_generalized_index(spec.BeaconState,
+                                  "finalized_checkpoint", "root")
+    g_sync = get_generalized_index(spec.BeaconState, "next_sync_committee")
+    warm_fin = build_proof(warm, "finalized_checkpoint", "root")
+    cold_fin = build_proof(cold, "finalized_checkpoint", "root")
+    assert [bytes(x) for x in warm_fin] == [bytes(x) for x in cold_fin]
+    warm_leaves, warm_proof = build_multiproof(warm, [g_fin, g_sync])
+    cold_leaves, cold_proof = build_multiproof(cold, [g_fin, g_sync])
+    assert [bytes(x) for x in warm_leaves] == [bytes(x) for x in cold_leaves]
+    assert [bytes(x) for x in warm_proof] == [bytes(x) for x in cold_proof]
+    assert verify_merkle_multiproof(cold_leaves, cold_proof,
+                                    [g_fin, g_sync], root)
+
+
 def test_light_client_multiproof_over_altair_state():
     """One multiproof authenticating finalized_checkpoint.root AND
     next_sync_committee — the two altair sync-protocol commitments

@@ -74,6 +74,16 @@ simnet runs) gate on the same state rule: a scenario whose deadline-mode
 previous round and violates it in the newest fails the round outright
 ("LATENCY SLO VIOLATED"); the p99 milliseconds are report-only.
 
+Proofs gating: rounds that carry a ``proofs`` section (`bench.py --mode
+proofs` — per-client-count light-client replay rows) gate on the same
+state rule: a shape whose every served artifact VERIFIED (the spec's
+``validate_light_client_update`` + ``is_valid_merkle_branch`` against an
+independently re-Merkleized root) in the previous round and stops
+verifying in the newest fails the round outright ("PROOFS DIVERGED",
+mirror of SIM DIVERGED — a proof plane serving unverifiable bytes is a
+correctness regression, not a perf number); proofs/sec, cache hit rate,
+and p99 movement are report-only.
+
 Output: the comparison table is also emitted as GitHub-flavored markdown
 — appended to ``$GITHUB_STEP_SUMMARY`` when CI sets it, printed to stdout
 otherwise — so the round-over-round numbers land on the workflow summary
@@ -276,6 +286,43 @@ def extract_latency(doc):
     return out
 
 
+def extract_proofs(doc):
+    """{``platform:proofs:<clients>``: {"ok", "proofs_per_sec",
+    "hit_rate", "p99_ms"}} from one round's ``proofs`` section
+    (`bench.py --mode proofs` light-client replay rows; ``ok`` = every
+    served artifact verified end to end)."""
+    parsed = doc.get("parsed")
+    if not isinstance(parsed, dict) or "error" in parsed:
+        return {}
+    section = parsed.get("proofs")
+    if not isinstance(section, dict):
+        return {}
+    plat = _platform(parsed)
+    out = {}
+    for name, row in sorted(section.items()):
+        if not isinstance(row, dict) or "verified" not in row:
+            continue
+        try:
+            pps = float(row.get("proofs_per_sec") or 0.0)
+        except (TypeError, ValueError):
+            pps = 0.0
+        try:
+            hit = float(row.get("hit_rate") or 0.0)
+        except (TypeError, ValueError):
+            hit = 0.0
+        try:
+            p99 = float(row.get("p99_ms") or 0.0)
+        except (TypeError, ValueError):
+            p99 = 0.0
+        out[f"{plat}:proofs:{name}"] = {
+            "ok": bool(row.get("verified", False)),
+            "proofs_per_sec": pps,
+            "hit_rate": hit,
+            "p99_ms": p99,
+        }
+    return out
+
+
 def extract_vmexec(doc):
     """{``platform:vmexec:<kind,rows>``: {"ok", "fused_ms_row",
     "interp_ms_row"}} from one round's ``vmexec`` section (`bench.py
@@ -392,6 +439,7 @@ def main(argv=None) -> int:
         new_vx = extract_vmexec(newest_doc)
         new_fleet = extract_fleet(newest_doc)
         new_lat = extract_latency(newest_doc)
+        new_proofs = extract_proofs(newest_doc)
     except (OSError, ValueError) as e:
         print(f"bench-compare: FAIL — {os.path.basename(newest)} unreadable: {e}")
         return 1
@@ -406,7 +454,8 @@ def main(argv=None) -> int:
         return 0
 
     prev_vals, prev_slo, prev_sim, prev_mesh = {}, {}, {}, {}
-    prev_fx, prev_vx, prev_fleet, prev_lat, prev_path = {}, {}, {}, {}, None
+    prev_fx, prev_vx, prev_fleet, prev_lat = {}, {}, {}, {}
+    prev_proofs, prev_path = {}, None
     for path in reversed(files[:-1]):
         try:
             doc = _load(path)
@@ -418,19 +467,20 @@ def main(argv=None) -> int:
             prev_vx = extract_vmexec(doc)
             prev_fleet = extract_fleet(doc)
             prev_lat = extract_latency(doc)
+            prev_proofs = extract_proofs(doc)
         except (OSError, ValueError):
             prev_vals, prev_slo, prev_sim = {}, {}, {}
             prev_mesh, prev_fx, prev_vx = {}, {}, {}
-            prev_fleet, prev_lat = {}, {}
+            prev_fleet, prev_lat, prev_proofs = {}, {}, {}
         # an SLO-only or sim-only round (headline errored, objectives or
         # scenario matrix still recorded) is a usable baseline for its
         # state gate even with no throughput number
         if (prev_vals or prev_slo or prev_sim or prev_mesh or prev_fx
-                or prev_vx or prev_fleet or prev_lat):
+                or prev_vx or prev_fleet or prev_lat or prev_proofs):
             prev_path = path
             break
     if not (prev_vals or prev_slo or prev_sim or prev_mesh or prev_fx
-            or prev_vx or prev_fleet or prev_lat):
+            or prev_vx or prev_fleet or prev_lat or prev_proofs):
         print("bench-compare: SKIP — no earlier round recorded a usable value")
         return 0
 
@@ -442,9 +492,10 @@ def main(argv=None) -> int:
     vx_common = sorted(set(new_vx) & set(prev_vx))
     fleet_common = sorted(set(new_fleet) & set(prev_fleet))
     lat_common = sorted(set(new_lat) & set(prev_lat))
+    proofs_common = sorted(set(new_proofs) & set(prev_proofs))
     if (not common and not slo_common and not sim_common
             and not mesh_common and not fx_common and not vx_common
-            and not fleet_common and not lat_common):
+            and not fleet_common and not lat_common and not proofs_common):
         # SLO keys count as comparables too: two rounds that share no
         # throughput shape but both declare serve_p99 must still gate the
         # objective state, not skip past it
@@ -586,6 +637,33 @@ def main(argv=None) -> int:
         if violated:
             failures.append(key)
 
+    # proofs state gate (ISSUE 16): a light-client replay shape whose
+    # every served artifact verified last round and stops verifying now
+    # fails outright — "PROOFS DIVERGED", the sim-gate mirror for the
+    # read path: a proof plane serving unverifiable bytes is a
+    # correctness regression; proofs/sec, cache hit rate, and p99 are
+    # report-only (CPU serve throughput jitters like every other number)
+    for key in proofs_common:
+        old, new = prev_proofs[key], new_proofs[key]
+        diverged = old["ok"] and not new["ok"]
+        status = "PROOFS DIVERGED" if diverged else (
+            "ok" if new["ok"] else "still diverged")
+        print(
+            f"  {key}: {old['proofs_per_sec']:.2f} -> "
+            f"{new['proofs_per_sec']:.2f} proofs/sec (hit "
+            f"{old['hit_rate']:.4f} -> {new['hit_rate']:.4f}, p99 "
+            f"{new['p99_ms']:.2f}ms; verified: {old['ok']} -> "
+            f"{new['ok']}){'  ' + status if diverged else ''}"
+        )
+        rows.append((key, f"{old['proofs_per_sec']:.2f}",
+                     f"{new['proofs_per_sec']:.2f}",
+                     (new["proofs_per_sec"] - old["proofs_per_sec"])
+                     / old["proofs_per_sec"]
+                     if old["proofs_per_sec"] else None,
+                     status))
+        if diverged:
+            failures.append(key)
+
     # finalexp state gate: a hard-part variant cell that worked last round
     # and errors (or returns wrong verdicts) now fails outright — losing a
     # finalization variant is a correctness/availability regression; the
@@ -661,6 +739,8 @@ def main(argv=None) -> int:
            if fleet_common else "")
         + (f", {len(lat_common)} latency scenario(s) gated"
            if lat_common else "")
+        + (f", {len(proofs_common)} proof shape(s) gated"
+           if proofs_common else "")
     )
     return 0
 
