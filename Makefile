@@ -22,7 +22,7 @@ CHEAP_GENERATORS = shuffling bls ssz_generic merkle
         sim-bench sim-smoke serve-bench-mesh mesh-smoke clean rlc-bench \
         finalexp-bench finalexp-smoke native sweep serve-fleet-bench fleet-smoke \
         latency-bench latency-smoke vmexec-bench vmexec-smoke vmexec-cold-smoke \
-        proof-bench proof-smoke
+        proof-bench proof-smoke merkle-bench merkle-smoke
 
 # fast default: BLS stubbed except @always_bls, 4-way process-parallel
 # (reference `make test` = pytest -n 4, reference Makefile:100)
@@ -180,8 +180,13 @@ fleet-smoke:
 # state-gated round over round by tools/bench_compare.py ("PROOFS
 # DIVERGED" when a previously-verified shape stops verifying);
 # proofs/sec and hit rate are report-only.
-proof-bench:
-	JAX_PLATFORMS=cpu python bench.py --mode proofs
+# 10^5 clients and a 16k-validator registry since the native
+# Merkleization plane (ISSUE 18); override via env
+CONSENSUS_SPECS_TPU_PROOF_CLIENTS ?= 100000
+proof-bench: native
+	JAX_PLATFORMS=cpu \
+	CONSENSUS_SPECS_TPU_PROOF_CLIENTS=$(CONSENSUS_SPECS_TPU_PROOF_CLIENTS) \
+	python bench.py --mode proofs
 
 # proof-plane CI canary (fleet-smoke's read-path sibling): one full
 # artifact served through a ProofService whose sync-committee signature
@@ -193,6 +198,27 @@ proof-bench:
 # failure). Out of tier-1: the workers pay real-backend compiles.
 proof-smoke:
 	JAX_PLATFORMS=cpu python -m consensus_specs_tpu.lightclient.proof_smoke
+
+# Merkleization plane race (ISSUE 18): the native batched hash_tree_root
+# path (csrc sha256_hash_many per tree level + incremental dirty-set
+# re-roots) vs the pure-python oracle on identical states — full-state
+# cold root, per-block incremental re-root, and the proof-world artifact
+# build+sign, each cell checked bit-identical. The JSON line's `merkle`
+# section is state-gated round over round by tools/bench_compare.py
+# ("MERKLE DIVERGED" when a cell's roots stop matching); speedups and
+# roots/sec are report-only. Builds the native kernel first.
+merkle-bench: native
+	JAX_PLATFORMS=cpu python bench.py --mode merkle
+
+# Merkleization CI canary: native == pure-python oracle BIT-IDENTITY
+# over every SSZ shape class (vectors, lists with length mix-ins,
+# bitlists, nested containers, zero-subtree padding) plus a seeded
+# random incremental-cache invalidation sweep (random dirty sets +
+# appends re-rooted against from-scratch rebuilds); journal dumps to
+# merkle_flight.jsonl (CI artifact on failure). Crypto-free and
+# compile-free — safe anywhere.
+merkle-smoke: native
+	JAX_PLATFORMS=cpu python -m consensus_specs_tpu.merkle.smoke
 
 # mesh convergence canary (CI): one serve flush on a 4-virtual-device
 # mesh through the STRICT verdict-identity gate (mesh == single-device ==
@@ -350,7 +376,9 @@ clean:
 		mesh_flight.*.jsonl finalexp_flight.*.jsonl fleet_flight.*.jsonl \
 		vmexec_flight.jsonl vmexec_flight.*.jsonl \
 		proof_flight.jsonl proof_flight.*.jsonl \
+		merkle_flight.jsonl merkle_flight.*.jsonl \
 		*-pid[0-9]*.jsonl
+	find . -name __pycache__ -type d -prune -exec rm -rf {} +
 
 # build the native kernels (csrc/): batched-SHA256 merkleization and the
 # VM assembler's scheduling+allocation kernel (ops/vm.py loads it via

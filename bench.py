@@ -580,6 +580,24 @@ def main():
         _emit_result(run_proofs_bench())
         return
 
+    if _cli_mode() == "merkle":
+        # Merkleization plane race (ISSUE 18): the native batched
+        # hash_tree_root path (one sha256_hash_many call per tree level,
+        # incremental dirty-set re-roots) vs the pure-python oracle on
+        # identical states — full-state cold root, per-block incremental
+        # re-root, and the proof-world artifact build. CPU-forced — the
+        # thing measured is the host Merkleization plane, not device
+        # math. Every cell checks bit-identity; the `merkle` section is
+        # state-gated round over round by tools/bench_compare.py
+        # ("MERKLE DIVERGED" when a cell's roots stop matching).
+        from consensus_specs_tpu.utils.jax_env import force_cpu
+
+        force_cpu()
+        from consensus_specs_tpu.bench.merkle import run_merkle_bench
+
+        _emit_result(run_merkle_bench())
+        return
+
     if _cli_mode() == "latency":
         # end-to-end gossip→head latency matrix (ISSUE 12): latency_skew
         # and lossy_links simnet scenarios, each under the classic

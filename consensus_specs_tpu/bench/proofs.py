@@ -34,6 +34,10 @@ CLIENTS_ENV = "CONSENSUS_SPECS_TPU_PROOF_CLIENTS"
 SLOTS_ENV = "CONSENSUS_SPECS_TPU_PROOF_SLOTS"
 WORKERS_ENV = "CONSENSUS_SPECS_TPU_PROOF_WORKERS"
 BACKEND_ENV = "CONSENSUS_SPECS_TPU_PROOF_BACKEND"
+# validator-registry depth of the proved states: gives artifact build a
+# realistically deep Merkle tree so the build+sign phase times the
+# Merkleization plane, not an empty state
+VALIDATORS_ENV = "CONSENSUS_SPECS_TPU_PROOF_VALIDATORS"
 
 
 class _OracleBackend:
@@ -86,9 +90,10 @@ def run_proofs_bench() -> dict:
     n_slots = max(1, int(os.environ.get(SLOTS_ENV, "8")))
     n_workers = max(1, int(os.environ.get(WORKERS_ENV, "4")))
     backend_kind = os.environ.get(BACKEND_ENV, "oracle").strip() or "oracle"
+    n_validators = int(os.environ.get(VALIDATORS_ENV, "16384"))
 
     spec = build_spec_module("altair", "minimal")
-    world = ProofWorld(spec)
+    world = ProofWorld(spec, validators=n_validators)
     if backend_kind == "verdict":
         from ..serve.load import VerdictBackend
 
@@ -110,6 +115,28 @@ def run_proofs_bench() -> dict:
 
     all_verified = True
     try:
+        # -- the artifact build+sign phase (the Merkleization plane's
+        # consumer-facing number): per-slot build_update_artifact timing
+        # on COLD states (fresh decode, no warm caches), native vs the
+        # forced pure-python oracle in the same round -----------------------
+        from ..merkle import levels as _merkle_levels
+
+        enc_fin = world.finalized_state.encode_bytes()
+
+        def timed_build_sign(mode: str, slot: int) -> float:
+            st = spec.BeaconState.decode_bytes(states[slot].encode_bytes())
+            fin = spec.BeaconState.decode_bytes(enc_fin)
+            with _merkle_levels.forced_mode(mode):
+                t0 = time.perf_counter()
+                build_update_artifact(
+                    spec, st, fin,
+                    genesis_validators_root=world.genesis_validators_root,
+                    sign=world.sign)
+                return time.perf_counter() - t0
+
+        bs_native = min(timed_build_sign("native", s) for s in head_slots)
+        bs_python = min(timed_build_sign("python", s) for s in head_slots)
+
         # -- warm + full verification of every distinct artifact ----------
         t_build = time.perf_counter()
         for s in head_slots:
@@ -170,6 +197,11 @@ def run_proofs_bench() -> dict:
             "slots": n_slots,
             "workers": n_workers,
             "backend": backend_kind,
+            "validators": n_validators,
+            # per-slot artifact build+sign on cold states: the native
+            # Merkleization plane vs the forced pure-python oracle
+            "build_sign_s_per_slot": round(bs_native, 4),
+            "build_sign_s_per_slot_python": round(bs_python, 4),
         }
     }
     return dict(
@@ -190,6 +222,9 @@ def run_proofs_bench() -> dict:
         hit_rate=round(hit_rate, 6),
         p99_ms=round(p99_ms, 4),
         build_s=round(build_s, 3),
+        build_sign_s_per_slot=round(bs_native, 4),
+        build_sign_s_per_slot_python=round(bs_python, 4),
+        validators=n_validators,
         elapsed_s=round(elapsed, 3),
         proofs=proofs_section,
         per_mode_best={f"proofs[{shape}]": round(pps, 2)},

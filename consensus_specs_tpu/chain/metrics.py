@@ -143,6 +143,12 @@ class ChainMetrics:
             )
         for label, value in zip(self._gauge_labels, values):
             profiling.set_gauge(label, value)
+        # the chain plane is the merkle plane's highest-rate consumer
+        # (per-block state re-roots), so its export also refreshes the
+        # process-wide merkle.* counters onto the same surface
+        from ..merkle import levels as _merkle_levels
+
+        _merkle_levels.export_gauges()
 
     def snapshot(self) -> Dict[str, float]:
         lat = profiling.latency_summary().get(self._apply_label, {})

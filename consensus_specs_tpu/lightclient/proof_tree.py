@@ -28,8 +28,8 @@ from typing import Callable, List, Optional, Sequence, Tuple
 
 from ..utils.ssz.gindex import get_generalized_index
 from ..utils.ssz.proofs import (
-    build_multiproof,
     build_proof,
+    build_proof_bundle,
     verify_merkle_multiproof,
 )
 
@@ -126,13 +126,20 @@ def build_update_artifact(
     assert g_fin == FINALIZED_ROOT_GINDEX and \
         g_sync == NEXT_SYNC_COMMITTEE_GINDEX
 
+    # every head-state extraction — the finality branch AND the combined
+    # multiproof — comes off ONE root hash with memoized node lookups
+    # (the branch and the multiproof helpers share their upper tree)
+    branches, leaves, proof = build_proof_bundle(
+        state,
+        paths=[("finalized_checkpoint", "root")],
+        gindices=[g_fin, g_sync],
+    )
     finality_branch = [
-        bytes(n) for n in build_proof(state, "finalized_checkpoint", "root")]
+        bytes(n) for n in branches[("finalized_checkpoint", "root")]]
     # the committee branch authenticates against the FINALIZED header's
     # state root (validate_light_client_update checks it there)
     sync_branch = [
         bytes(n) for n in build_proof(finalized_state, "next_sync_committee")]
-    leaves, proof = build_multiproof(state, [g_fin, g_sync])
 
     if fork_version is None:
         fork_version = spec.config.GENESIS_FORK_VERSION
@@ -262,7 +269,8 @@ class ProofWorld:
     """
 
     def __init__(self, spec, *, sks=None,
-                 genesis_validators_root: bytes = b"\x10" * 32):
+                 genesis_validators_root: bytes = b"\x10" * 32,
+                 validators: int = 0):
         from ..utils import bls
 
         self.spec = spec
@@ -277,6 +285,25 @@ class ProofWorld:
             pubkeys=[spec.BLSPubkey(pk) for pk in self.pubkeys],
             aggregate_pubkey=spec.BLSPubkey(agg))
         self.genesis_validators_root = bytes(genesis_validators_root)
+        # optional validator registry: gives the proved states a
+        # realistically deep tree, so artifact-build timing exercises the
+        # Merkleization plane (pubkeys are synthetic — branch extraction
+        # and signing never read them)
+        self.n_validators = int(validators)
+        self._validators = [
+            spec.Validator(
+                pubkey=spec.BLSPubkey(
+                    (i + 1).to_bytes(48, "little")),
+                withdrawal_credentials=spec.Bytes32(
+                    (i + 1).to_bytes(32, "little")),
+                effective_balance=spec.Gwei(32 * 10**9),
+                activation_epoch=spec.Epoch(0),
+                exit_epoch=spec.Epoch(2**64 - 1),
+                withdrawable_epoch=spec.Epoch(2**64 - 1),
+            )
+            for i in range(self.n_validators)
+        ]
+        self._balances = [spec.Gwei(32 * 10**9)] * self.n_validators
 
         period_slots = int(spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD) * \
             int(spec.SLOTS_PER_EPOCH)
@@ -288,6 +315,9 @@ class ProofWorld:
         fin.slot = spec.Slot(self.finalized_slot)
         fin.current_sync_committee = self.committee
         fin.next_sync_committee = self.committee
+        if self._validators:
+            fin.validators = self._validators
+            fin.balances = self._balances
         self.finalized_state = fin
         self.finalized_state_root = bytes(fin.hash_tree_root())
         fin_header = spec.BeaconBlockHeader(
@@ -307,6 +337,9 @@ class ProofWorld:
         state.slot = spec.Slot(slot)
         state.current_sync_committee = self.committee
         state.next_sync_committee = self.committee
+        if self._validators:
+            state.validators = self._validators
+            state.balances = self._balances
         state.finalized_checkpoint = spec.Checkpoint(
             epoch=spec.Epoch(
                 self.finalized_slot // int(spec.SLOTS_PER_EPOCH)),

@@ -57,6 +57,8 @@ STAGES: Tuple[str, ...] = (
     # the light-client proof plane (ISSUE 16): artifact build, signature
     # verdict wait, and the full serve() request (hit or build)
     "proof_build", "proof_verify", "proof_serve",
+    # the Merkleization plane (ISSUE 18): every ssz_impl.hash_tree_root
+    "merkle_root",
 )
 
 # what a QUEUED serve item still has ahead of it — the stages whose

@@ -184,6 +184,18 @@ GAUGES: Dict[str, str] = {
     "lightclient.verify_failures": "sync-committee signature verdicts "
                                    "that came back False (the artifact "
                                    "is still served, flagged unverified)",
+    "merkle.native_levels": "tree levels hashed through one batched "
+                            "native sha256_hash_many call (vs per-pair "
+                            "hashlib)",
+    "merkle.cache_hits": "hash_tree_root calls answered by the "
+                         "incremental layer cache (dirty-set re-hash "
+                         "instead of a cold rebuild)",
+    "merkle.dirty_nodes": "tree nodes re-hashed by incremental "
+                          "dirty-set propagation (O(log N · changed) "
+                          "per update)",
+    "merkle.fallbacks": "Merkleization batch attempts that fell back "
+                        "to the pure-python path (native lib missing "
+                        "or dynamically-shaped elements)",
 }
 
 STATS: Dict[str, str] = {
@@ -230,7 +242,9 @@ DYNAMIC_PREFIXES: Dict[str, tuple] = {
                                   "set (ingress/queue_wait/prep/device/"
                                   "combine/finalize/validate/sig_wait/"
                                   "apply/sweep/head plus the proof plane's "
-                                  "proof_build/proof_verify/proof_serve)"),
+                                  "proof_build/proof_verify/proof_serve "
+                                  "and the Merkleization plane's "
+                                  "merkle_root)"),
     # node-labelled instance families (simnet: N HeadService /
     # VerificationService instances in ONE process — the bare chain.* /
     # serve.* gauges would collide, so each instance exports under

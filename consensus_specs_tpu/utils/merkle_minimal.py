@@ -8,7 +8,7 @@ these against ``is_valid_merkle_branch`` — the tree layout contract is:
 odd tails hash against the zero-subtree of their depth, and a proof is
 the sibling (or zero-hash) at every level below the root.
 """
-from .hash_function import hash
+from ..merkle import levels as _levels
 from .ssz.ssz_typing import ZERO_HASHES as zerohashes  # shared table
 from .ssz.ssz_typing import merkleize_chunks, next_power_of_two  # re-export
 
@@ -25,10 +25,10 @@ __all__ = [
 
 def _parent_level(level, depth):
     """Hash one level into its parents; an odd tail pairs with the
-    zero-subtree hash of ``depth`` (the canonical sparse-padding rule)."""
-    if len(level) % 2:
-        level = level + [zerohashes[depth]]
-    return [hash(left + right) for left, right in zip(level[::2], level[1::2])]
+    zero-subtree hash of ``depth`` (the canonical sparse-padding rule).
+    Routed through the batched level hasher: one native call per level
+    under CONSENSUS_SPECS_TPU_MERKLE=native/auto."""
+    return _levels.hash_level(list(level), depth)
 
 
 def calc_merkle_tree_from_leaves(values, layer_count=32):

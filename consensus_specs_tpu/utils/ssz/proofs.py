@@ -291,3 +291,37 @@ def build_multiproof(
     leaves = [_node(view, g) for g in gindices]
     proof = [_node(view, g) for g in get_helper_indices(gindices)]
     return leaves, proof
+
+
+def build_proof_bundle(
+    view: View,
+    *,
+    paths: Sequence[Tuple] = (),
+    gindices: Sequence[GeneralizedIndex] = (),
+) -> Tuple[Dict[Tuple, PyList[bytes]], PyList[bytes], PyList[bytes]]:
+    """Every branch (one per ``paths`` entry) AND the multiproof of
+    ``gindices`` off ONE cache-refreshing root hash, with node lookups
+    memoized across all of them — branches and multiproof helpers share
+    most of their upper tree, so per-artifact extraction (lightclient
+    proof_tree) reads each cached level node once instead of re-walking
+    the descent per gindex. Returns ``(branches_by_path, leaves, proof)``.
+    """
+    view.hash_tree_root()  # ONE refresh for everything extracted below
+    memo: Dict[int, bytes] = {}
+
+    def node(g: GeneralizedIndex) -> bytes:
+        k = int(g)
+        r = memo.get(k)
+        if r is None:
+            r = memo[k] = _node(view, g)
+        return r
+
+    branches = {
+        tuple(path): [node(i) for i in
+                      get_branch_indices(
+                          get_generalized_index(type(view), *path))]
+        for path in paths
+    }
+    leaves = [node(g) for g in gindices]
+    proof = [node(g) for g in get_helper_indices(gindices)]
+    return branches, leaves, proof

@@ -14,9 +14,21 @@ def serialize(obj: View) -> bytes:
 
 
 def hash_tree_root(obj: View) -> "bytes":
+    from time import perf_counter
+
+    from ...merkle import levels as _levels
     from .ssz_typing import Bytes32
 
-    return Bytes32(obj.hash_tree_root())
+    t0 = perf_counter()
+    root = Bytes32(obj.hash_tree_root())
+    _levels.note_root_seconds(perf_counter() - t0)
+    if _levels.diff_enabled():
+        # CONSENSUS_SPECS_TPU_MERKLE_DIFF=1: re-derive through the pure
+        # python oracle on a cold decode and demand bit-identity
+        from ...merkle import plane as _plane
+
+        _plane.diff_check(obj, root)
+    return root
 
 
 def uint_to_bytes(n: uint) -> bytes:

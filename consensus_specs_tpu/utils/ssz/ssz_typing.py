@@ -39,6 +39,23 @@ import itertools
 from hashlib import sha256
 from typing import Any, Dict, Optional, Sequence, Tuple, Type
 
+from ...merkle import levels as _merkle_levels
+from ...merkle.cache import LevelTree
+
+# the cross-element cold-build plane imports THIS module back, so it can
+# only be reached lazily (resolved on the first cold composite build)
+_merkle_plane = None
+
+
+def _get_merkle_plane():
+    global _merkle_plane
+    if _merkle_plane is None:
+        from ...merkle import plane
+
+        _merkle_plane = plane
+    return _merkle_plane
+
+
 _MUT_COUNTER = itertools.count(1)
 
 
@@ -53,9 +70,9 @@ BITS_PER_BYTE = 8
 # zero-hash table + merkleize core (reference: utils/merkle_minimal.py:7-89)
 # ---------------------------------------------------------------------------
 
-ZERO_HASHES = [b"\x00" * 32]
-for _ in range(64):
-    ZERO_HASHES.append(sha256(ZERO_HASHES[-1] + ZERO_HASHES[-1]).digest())
+# one shared zero-subtree table (the merkle plane owns it: levels.py is
+# import-cycle-free and every plane layer reads the same list object)
+ZERO_HASHES = _merkle_levels.ZERO_HASHES
 
 
 def next_power_of_two(v: int) -> int:
@@ -65,7 +82,10 @@ def next_power_of_two(v: int) -> int:
 
 
 def merkleize_chunks(chunks: Sequence[bytes], limit: Optional[int] = None) -> bytes:
-    """Merkleize 32-byte chunks, padding with zero-chunks up to next_pow2(limit or count)."""
+    """Merkleize 32-byte chunks, padding with zero-chunks up to next_pow2(limit or count).
+    Each level hashes through the merkle plane's batched level hasher
+    (one native sha256_hash_many call per level when the
+    CONSENSUS_SPECS_TPU_MERKLE mode allows and the level is wide enough)."""
     count = len(chunks)
     if limit is None:
         limit = count
@@ -77,105 +97,14 @@ def merkleize_chunks(chunks: Sequence[bytes], limit: Optional[int] = None) -> by
         return ZERO_HASHES[depth]
     layer = list(chunks)
     for level in range(depth):
-        if len(layer) % 2 == 1:
-            layer.append(ZERO_HASHES[level])
-        n_pairs = len(layer) // 2
-        if n_pairs >= 8 and _native_hash_pairs is not None:
-            # one native call per LAYER (csrc/sha256_batch.c) instead of a
-            # hashlib round-trip per node pair
-            digests = _native_hash_pairs(b"".join(layer))
-            layer = [digests[32 * i: 32 * (i + 1)] for i in range(n_pairs)]
-        else:
-            layer = [
-                sha256(layer[2 * i] + layer[2 * i + 1]).digest()
-                for i in range(n_pairs)
-            ]
+        layer = _merkle_levels.hash_level(layer, level)
     return layer[0]
 
 
-def _load_native_hash_pairs():
-    try:
-        from ..native_sha256 import available, hash_pairs
-
-        return hash_pairs if available() else None
-    except Exception:
-        return None
-
-
-_native_hash_pairs = _load_native_hash_pairs()
-
-
-class _ChunkTree:
-    """Merkle layer cache over a virtual zero-padded tree of fixed depth.
-
-    Stores only the PRESENT nodes of each layer (absent right siblings are
-    the zero-subtree hashes), so a List[_, 2^40] with n chunks costs ~2n
-    nodes. `set_chunk`/`append` update the O(log n) root path; `root()`
-    folds the top present node with zero hashes up to the type's depth —
-    bit-identical to `merkleize_chunks` (cross-checked in
-    tests/test_ssz_incremental.py)."""
-
-    __slots__ = ("depth", "layers")
-
-    def __init__(self, depth: int, chunks: Sequence[bytes]):
-        self.depth = depth
-        self.layers = [list(chunks)]
-        self._build_above(0)
-
-    def _build_above(self, level: int) -> None:
-        del self.layers[level + 1 :]
-        cur = self.layers[level]
-        lv = level
-        while len(cur) > 1:
-            src = cur if len(cur) % 2 == 0 else cur + [ZERO_HASHES[lv]]
-            n_pairs = len(src) // 2
-            if n_pairs >= 8 and _native_hash_pairs is not None:
-                digests = _native_hash_pairs(b"".join(src))
-                nxt = [digests[32 * i : 32 * (i + 1)] for i in range(n_pairs)]
-            else:
-                nxt = [
-                    sha256(src[2 * i] + src[2 * i + 1]).digest()
-                    for i in range(n_pairs)
-                ]
-            self.layers.append(nxt)
-            cur = nxt
-            lv += 1
-
-    def _update_path(self, i: int) -> None:
-        for lv in range(len(self.layers) - 1):
-            cur = self.layers[lv]
-            up = self.layers[lv + 1]
-            pi = i // 2
-            left = cur[2 * pi]
-            right = cur[2 * pi + 1] if 2 * pi + 1 < len(cur) else ZERO_HASHES[lv]
-            h = sha256(left + right).digest()
-            if pi == len(up):
-                up.append(h)
-            else:
-                up[pi] = h
-            i = pi
-        # growth past a power-of-two boundary needs a new top layer
-        while len(self.layers[-1]) > 1:
-            self._build_above(len(self.layers) - 1)
-
-    def n_chunks(self) -> int:
-        return len(self.layers[0])
-
-    def set_chunk(self, i: int, chunk: bytes) -> None:
-        self.layers[0][i] = chunk
-        self._update_path(i)
-
-    def append(self, chunk: bytes) -> None:
-        self.layers[0].append(chunk)
-        self._update_path(len(self.layers[0]) - 1)
-
-    def root(self) -> bytes:
-        if not self.layers[0]:
-            return ZERO_HASHES[self.depth]
-        node = self.layers[-1][0]
-        for lv in range(len(self.layers) - 1, self.depth):
-            node = sha256(node + ZERO_HASHES[lv]).digest()
-        return node
+# the incremental layer cache lives in the merkle plane now; the engine
+# keeps its historical name (proofs.py and the incremental tests read
+# `_ChunkTree` and its `layers` directly)
+_ChunkTree = LevelTree
 
 
 def _type_depth(limit: int) -> int:
@@ -737,12 +666,12 @@ class Bitlist(View):
             tree = _ChunkTree(depth, pack_bytes_into_chunks(_bits_to_bytes(self._bits)))
             self._htr_tree = tree
         else:
+            _merkle_levels.counters["cache_hits"] += 1
             prev_chunks = tree.n_chunks()
-            d = {ci for ci in dirty if ci < prev_chunks}
-            for ci in sorted(d):
-                tree.set_chunk(ci, self._bit_chunk(ci))
-            for ci in range(prev_chunks, n_chunks):
-                tree.append(self._bit_chunk(ci))
+            tree.update(
+                {ci: self._bit_chunk(ci) for ci in dirty if ci < prev_chunks},
+                [self._bit_chunk(ci) for ci in range(prev_chunks, n_chunks)],
+            )
         self._htr_dirty = set()
         self._htr_nbits = nbits
         return mix_in_length(tree.root(), nbits)
@@ -852,22 +781,25 @@ class ComplexSeries(View):
             per = 32 // es
             n_chunks = (len(self._elems) + per - 1) // per
             if tree is None or dirty is None or n_chunks < tree.n_chunks():
-                tree = _ChunkTree(
-                    depth,
-                    pack_bytes_into_chunks(
-                        b"".join(e.encode_bytes() for e in self._elems)
-                    ),
-                )
+                raw = None
+                if len(self._elems) >= 256 and _merkle_levels.plane_enabled():
+                    raw = _get_merkle_plane().packed_basic_raw(
+                        self.ELEM_TYPE, self._elems)
+                if raw is None:
+                    raw = b"".join(e.encode_bytes() for e in self._elems)
+                tree = _ChunkTree(depth, pack_bytes_into_chunks(raw))
                 self._htr_tree = tree
             else:
+                _merkle_levels.counters["cache_hits"] += 1
                 prev = tree.n_chunks()
                 dchunks = {i // per for i in dirty if i // per < prev}
                 if n_chunks > prev and prev > 0:
                     dchunks.add(prev - 1)  # boundary chunk gained elements
-                for ci in sorted(dchunks):
-                    tree.set_chunk(ci, self._basic_chunk(ci, per))
-                for ci in range(prev, n_chunks):
-                    tree.append(self._basic_chunk(ci, per))
+                tree.update(
+                    {ci: self._basic_chunk(ci, per) for ci in dchunks},
+                    [self._basic_chunk(ci, per)
+                     for ci in range(prev, n_chunks)],
+                )
             self._htr_dirty = set()
             return tree.root()
 
@@ -876,34 +808,59 @@ class ComplexSeries(View):
         etags = getattr(self, "_htr_etags", None)
         n = len(self._elems)
         if tree is None or eroots is None or n < len(eroots):
-            eroots = [e.hash_tree_root() for e in self._elems]
-            etags = [_deep_stamp(e) for e in self._elems]
+            # cold build: the cross-element plane computes EVERY element
+            # root column-wise through batched native level hashing;
+            # dynamically-shaped element types fall back per element
+            eroots = None
+            if n >= 8:
+                eroots = _get_merkle_plane().batched_element_roots(self._elems)
+            if eroots is None:
+                eroots = [e.hash_tree_root() for e in self._elems]
+            if (issubclass(self.ELEM_TYPE, Container)
+                    and not _container_stamp_fields(self.ELEM_TYPE)):
+                etags = [getattr(e, "_mut", 0) for e in self._elems]
+            else:
+                etags = [_deep_stamp(e) for e in self._elems]
             self._htr_tree = tree = _ChunkTree(depth, list(eroots))
             self._htr_eroots = eroots
             self._htr_etags = etags
             self._htr_dirty = set()
             return tree.root()
 
+        _merkle_levels.counters["cache_hits"] += 1
         # deep mutations through read aliases: elements whose stamp moved
         if _mutable_core(self.ELEM_TYPE):
             dirty = set(dirty)
             elems = self._elems
-            for i in range(len(eroots)):
-                if _deep_stamp(elems[i]) != etags[i]:
-                    dirty.add(i)
+            if (issubclass(self.ELEM_TYPE, Container)
+                    and not _container_stamp_fields(self.ELEM_TYPE)):
+                # leaf-only containers (e.g. Validator): the deep stamp
+                # IS the element's own _mut — scan without the recursive
+                # call (this scan runs per warm root over the whole
+                # series, so it is the registry re-root's hot loop)
+                for i in range(len(eroots)):
+                    if getattr(elems[i], "_mut", 0) != etags[i]:
+                        dirty.add(i)
+            else:
+                for i in range(len(eroots)):
+                    if _deep_stamp(elems[i]) != etags[i]:
+                        dirty.add(i)
+        updates = {}
         for i in sorted(d for d in dirty if d < len(eroots)):
             e = self._elems[i]
             r = e.hash_tree_root()
             etags[i] = _deep_stamp(e)
             if r != eroots[i]:
                 eroots[i] = r
-                tree.set_chunk(i, r)
+                updates[i] = r
+        appends = []
         for i in range(len(eroots), n):  # appended elements
             e = self._elems[i]
             r = e.hash_tree_root()
             eroots.append(r)
             etags.append(_deep_stamp(e))
-            tree.append(r)
+            appends.append(r)
+        tree.update(updates, appends)
         self._htr_dirty = set()
         return tree.root()
 

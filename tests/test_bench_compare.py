@@ -906,3 +906,104 @@ def test_proofs_extract_shapes(bc):
             "p99_ms": 0.028}}
     assert bc.extract_proofs({"parsed": {"error": "boom"}}) == {}
     assert bc.extract_proofs({"parsed": _parsed(300.0)}) == {}
+
+
+# -- the Merkleization state gate (ISSUE 18) ---------------------------------
+
+
+def _merkle_parsed(value, cells, **extra):
+    """A `--mode merkle` round: cells maps cell name ->
+    (ok, speedup)."""
+    section = {
+        name: {"ok": ok, "speedup": spd, "native_s": 0.1, "python_s": 0.6}
+        for name, (ok, spd) in cells.items()
+    }
+    return _parsed(value, mode="merkle", n=None, k=None,
+                   merkle=section, **extra)
+
+
+def test_merkle_newly_diverged_cell_fails(tmp_path, bc, capsys):
+    """The merkle gate: a race cell whose native batched root was
+    bit-identical to the pure-python oracle last round and diverges in
+    the newest fails outright — "MERKLE DIVERGED", the proofs-gate
+    mirror for the hashing plane."""
+    _write_round(tmp_path, 1, _merkle_parsed(
+        300.0, {"state_cold": (True, 6.0)}))
+    _write_round(tmp_path, 2, _merkle_parsed(
+        300.0, {"state_cold": (False, 7.5)}))
+    assert bc.main(["--dir", str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "cpu:merkle:state_cold" in out and "MERKLE DIVERGED" in out
+
+
+def test_merkle_speedup_movement_is_report_only(tmp_path, bc, capsys):
+    """Speedup shrinking (even below 1x) never fails the merkle gate on
+    its own — CPU hashing throughput jitters; the page-worthy event is
+    bit-identity breaking."""
+    _write_round(tmp_path, 1, _merkle_parsed(
+        300.0, {"state_cold": (True, 6.0),
+                "state_incremental": (True, 46.0)}))
+    _write_round(tmp_path, 2, _merkle_parsed(
+        290.0, {"state_cold": (True, 0.8),
+                "state_incremental": (True, 2.0)}))
+    assert bc.main(["--dir", str(tmp_path)]) == 0
+    assert "cpu:merkle:state_cold" in capsys.readouterr().out
+
+
+def test_merkle_still_diverged_is_not_a_new_failure(tmp_path, bc):
+    """ok False -> False: the flip round already failed once; a
+    permanently-red cell must not wedge every future round."""
+    _write_round(tmp_path, 1, _merkle_parsed(
+        300.0, {"proof_world": (False, 3.0)}))
+    _write_round(tmp_path, 2, _merkle_parsed(
+        300.0, {"proof_world": (False, 3.0)}))
+    assert bc.main(["--dir", str(tmp_path)]) == 0
+
+
+def test_merkle_keys_join_without_common_throughput_keys(tmp_path, bc,
+                                                         capsys):
+    """Shared merkle keys are comparables in their own right (the
+    SLO/sim/proofs rule): disjoint throughput shapes must still gate an
+    identical -> diverged transition instead of skipping."""
+    _write_round(tmp_path, 1, _parsed(
+        1000.0, mode="head", n=None, k=None, blocks=1024,
+        merkle={"state_cold": {"ok": True, "speedup": 6.0}}))
+    _write_round(tmp_path, 2, _parsed(
+        900.0, mode="head", n=None, k=None, blocks=128,
+        merkle={"state_cold": {"ok": False, "speedup": 6.0}}))
+    assert bc.main(["--dir", str(tmp_path)]) == 1
+    assert "MERKLE DIVERGED" in capsys.readouterr().out
+
+
+def test_merkle_only_previous_round_is_a_usable_baseline(tmp_path, bc,
+                                                         capsys):
+    """A prior round whose headline value is unusable but whose merkle
+    section recorded bit-identity state still baselines the merkle gate —
+    the walk must not skip past it to 'no earlier round'."""
+    broken = _merkle_parsed(300.0, {"state_cold": (True, 6.0)})
+    broken["value"] = 0.0  # headline unusable, merkle section intact
+    _write_round(tmp_path, 1, broken)
+    _write_round(tmp_path, 2, _merkle_parsed(
+        300.0, {"state_cold": (False, 6.0)}))
+    assert bc.main(["--dir", str(tmp_path)]) == 1
+    assert "MERKLE DIVERGED" in capsys.readouterr().out
+
+
+def test_merkle_new_cells_are_not_gated_until_seen(tmp_path, bc):
+    """A race cell appearing for the first time has no baseline —
+    report-only this round, gated from the next."""
+    _write_round(tmp_path, 1, _merkle_parsed(
+        300.0, {"state_cold": (True, 6.0)}))
+    _write_round(tmp_path, 2, _merkle_parsed(
+        300.0, {"state_cold": (True, 6.0),
+                "state_incremental": (False, 0.0)}))
+    assert bc.main(["--dir", str(tmp_path)]) == 0
+
+
+def test_merkle_extract_shapes(bc):
+    doc = {"parsed": _merkle_parsed(
+        300.0, {"state_cold": (True, 6.01)})}
+    assert bc.extract_merkle(doc) == {
+        "cpu:merkle:state_cold": {"ok": True, "speedup": 6.01}}
+    assert bc.extract_merkle({"parsed": {"error": "boom"}}) == {}
+    assert bc.extract_merkle({"parsed": _parsed(300.0)}) == {}

@@ -102,6 +102,27 @@ def test_vm_gauge_families_are_complete():
     )
 
 
+def test_merkle_gauge_family_is_complete():
+    # the Merkleization plane (ISSUE 18): every merkle.* gauge
+    # merkle/levels.export_gauges emits must be registered and every
+    # registered merkle.* gauge must have an emission site, and the
+    # family must track the counters dict one-to-one (a new counter
+    # that skips export_gauges never reaches a scrape)
+    from consensus_specs_tpu.merkle import levels as merkle_levels
+
+    emitted = {label for label in _emitted_labels()
+               if label.startswith("merkle.")}
+    registered = {n for n in registry.GAUGES if n.startswith("merkle.")}
+    assert emitted == registered, (
+        f"merkle gauge drift: emitted-not-registered="
+        f"{emitted - registered}, registered-not-emitted="
+        f"{registered - emitted}"
+    )
+    assert {f"merkle.{k}" for k in merkle_levels.counters} == registered, (
+        "merkle counters dict and registered merkle.* gauges diverged"
+    )
+
+
 def test_chain_gauge_family_is_complete():
     # the chain plane exports its whole gauge family from one tuple; every
     # member must be a registered gauge and every registered chain gauge

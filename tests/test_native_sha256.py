@@ -23,17 +23,15 @@ def test_native_matches_hashlib():
 
 def test_merkleize_uses_native_consistently():
     # hash_tree_root must be identical whichever path runs
+    from consensus_specs_tpu.merkle import levels
     from consensus_specs_tpu.utils.ssz import ssz_typing as tz
 
     chunks = [bytes([i]) * 32 for i in range(33)]
-    root = tz.merkleize_chunks(chunks, limit=64)
+    with levels.forced_mode("native"):
+        root = tz.merkleize_chunks(chunks, limit=64)
     # force the pure path and compare
-    saved = tz._native_hash_pairs
-    tz._native_hash_pairs = None
-    try:
+    with levels.forced_mode("python"):
         assert tz.merkleize_chunks(chunks, limit=64) == root
-    finally:
-        tz._native_hash_pairs = saved
 
 
 def test_layer_batching_throughput_sanity():

@@ -84,6 +84,15 @@ mirror of SIM DIVERGED — a proof plane serving unverifiable bytes is a
 correctness regression, not a perf number); proofs/sec, cache hit rate,
 and p99 movement are report-only.
 
+Merkle gating: rounds that carry a ``merkle`` section (`bench.py --mode
+merkle` — native-vs-python Merkleization race cells) gate on the same
+state rule: a cell whose native batched root was BIT-IDENTICAL to the
+pure-python oracle in the previous round and diverges in the newest
+fails the round outright ("MERKLE DIVERGED" — a hashing plane producing
+wrong state roots is a consensus-correctness regression, not a perf
+number); the cold/incremental/proof-world speedups and roots/sec are
+report-only.
+
 Output: the comparison table is also emitted as GitHub-flavored markdown
 — appended to ``$GITHUB_STEP_SUMMARY`` when CI sets it, printed to stdout
 otherwise — so the round-over-round numbers land on the workflow summary
@@ -323,6 +332,33 @@ def extract_proofs(doc):
     return out
 
 
+def extract_merkle(doc):
+    """{``platform:merkle:<cell>``: {"ok", "speedup"}} from one round's
+    ``merkle`` section (`bench.py --mode merkle` native-vs-python
+    Merkleization race cells; ``ok`` = the two paths' roots are
+    bit-identical). Speedups and roots/sec are report-only."""
+    parsed = doc.get("parsed")
+    if not isinstance(parsed, dict) or "error" in parsed:
+        return {}
+    section = parsed.get("merkle")
+    if not isinstance(section, dict):
+        return {}
+    plat = _platform(parsed)
+    out = {}
+    for name, row in sorted(section.items()):
+        if not isinstance(row, dict) or "ok" not in row:
+            continue
+        try:
+            speedup = float(row.get("speedup") or 0.0)
+        except (TypeError, ValueError):
+            speedup = 0.0
+        out[f"{plat}:merkle:{name}"] = {
+            "ok": bool(row.get("ok", False)),
+            "speedup": speedup,
+        }
+    return out
+
+
 def extract_vmexec(doc):
     """{``platform:vmexec:<kind,rows>``: {"ok", "fused_ms_row",
     "interp_ms_row"}} from one round's ``vmexec`` section (`bench.py
@@ -440,6 +476,7 @@ def main(argv=None) -> int:
         new_fleet = extract_fleet(newest_doc)
         new_lat = extract_latency(newest_doc)
         new_proofs = extract_proofs(newest_doc)
+        new_merkle = extract_merkle(newest_doc)
     except (OSError, ValueError) as e:
         print(f"bench-compare: FAIL — {os.path.basename(newest)} unreadable: {e}")
         return 1
@@ -455,7 +492,7 @@ def main(argv=None) -> int:
 
     prev_vals, prev_slo, prev_sim, prev_mesh = {}, {}, {}, {}
     prev_fx, prev_vx, prev_fleet, prev_lat = {}, {}, {}, {}
-    prev_proofs, prev_path = {}, None
+    prev_proofs, prev_merkle, prev_path = {}, {}, None
     for path in reversed(files[:-1]):
         try:
             doc = _load(path)
@@ -468,19 +505,23 @@ def main(argv=None) -> int:
             prev_fleet = extract_fleet(doc)
             prev_lat = extract_latency(doc)
             prev_proofs = extract_proofs(doc)
+            prev_merkle = extract_merkle(doc)
         except (OSError, ValueError):
             prev_vals, prev_slo, prev_sim = {}, {}, {}
             prev_mesh, prev_fx, prev_vx = {}, {}, {}
             prev_fleet, prev_lat, prev_proofs = {}, {}, {}
+            prev_merkle = {}
         # an SLO-only or sim-only round (headline errored, objectives or
         # scenario matrix still recorded) is a usable baseline for its
         # state gate even with no throughput number
         if (prev_vals or prev_slo or prev_sim or prev_mesh or prev_fx
-                or prev_vx or prev_fleet or prev_lat or prev_proofs):
+                or prev_vx or prev_fleet or prev_lat or prev_proofs
+                or prev_merkle):
             prev_path = path
             break
     if not (prev_vals or prev_slo or prev_sim or prev_mesh or prev_fx
-            or prev_vx or prev_fleet or prev_lat or prev_proofs):
+            or prev_vx or prev_fleet or prev_lat or prev_proofs
+            or prev_merkle):
         print("bench-compare: SKIP — no earlier round recorded a usable value")
         return 0
 
@@ -493,9 +534,11 @@ def main(argv=None) -> int:
     fleet_common = sorted(set(new_fleet) & set(prev_fleet))
     lat_common = sorted(set(new_lat) & set(prev_lat))
     proofs_common = sorted(set(new_proofs) & set(prev_proofs))
+    merkle_common = sorted(set(new_merkle) & set(prev_merkle))
     if (not common and not slo_common and not sim_common
             and not mesh_common and not fx_common and not vx_common
-            and not fleet_common and not lat_common and not proofs_common):
+            and not fleet_common and not lat_common and not proofs_common
+            and not merkle_common):
         # SLO keys count as comparables too: two rounds that share no
         # throughput shape but both declare serve_p99 must still gate the
         # objective state, not skip past it
@@ -664,6 +707,30 @@ def main(argv=None) -> int:
         if diverged:
             failures.append(key)
 
+    # merkle state gate (ISSUE 18): a Merkleization race cell whose
+    # native and python roots were bit-identical last round and diverge
+    # now fails outright — "MERKLE DIVERGED", the proofs-gate mirror for
+    # the hashing plane: a native hash_tree_root that stops matching the
+    # pure-python oracle is a consensus-correctness regression, not a
+    # perf number; the speedup movement (cold, incremental, proof-world)
+    # is report-only like every other CPU throughput figure
+    for key in merkle_common:
+        old, new = prev_merkle[key], new_merkle[key]
+        diverged = old["ok"] and not new["ok"]
+        status = "MERKLE DIVERGED" if diverged else (
+            "ok" if new["ok"] else "still diverged")
+        print(
+            f"  {key}: {old['speedup']:.2f}x -> {new['speedup']:.2f}x "
+            f"native speedup (bit-identical: {old['ok']} -> {new['ok']})"
+            f"{'  ' + status if diverged else ''}"
+        )
+        rows.append((key, f"{old['speedup']:.2f}x", f"{new['speedup']:.2f}x",
+                     (new["speedup"] - old["speedup"]) / old["speedup"]
+                     if old["speedup"] else None,
+                     status))
+        if diverged:
+            failures.append(key)
+
     # finalexp state gate: a hard-part variant cell that worked last round
     # and errors (or returns wrong verdicts) now fails outright — losing a
     # finalization variant is a correctness/availability regression; the
@@ -741,6 +808,8 @@ def main(argv=None) -> int:
            if lat_common else "")
         + (f", {len(proofs_common)} proof shape(s) gated"
            if proofs_common else "")
+        + (f", {len(merkle_common)} merkle cell(s) gated"
+           if merkle_common else "")
     )
     return 0
 
