@@ -22,7 +22,7 @@ CHEAP_GENERATORS = shuffling bls ssz_generic merkle
         sim-bench sim-smoke serve-bench-mesh mesh-smoke clean rlc-bench \
         finalexp-bench finalexp-smoke native sweep serve-fleet-bench fleet-smoke \
         latency-bench latency-smoke vmexec-bench vmexec-smoke vmexec-cold-smoke \
-        proof-bench proof-smoke merkle-bench merkle-smoke
+        proof-bench proof-smoke merkle-bench merkle-smoke soak-bench soak-smoke
 
 # fast default: BLS stubbed except @always_bls, 4-way process-parallel
 # (reference `make test` = pytest -n 4, reference Makefile:100)
@@ -264,6 +264,29 @@ sim-bench:
 # artifacts on failure; exits nonzero with the divergence diagnosis
 sim-smoke:
 	JAX_PLATFORMS=cpu python -m consensus_specs_tpu.sim.smoke
+
+# long-horizon telemetry soak (ISSUE 19): a 128-epoch (1000+ slot)
+# simnet scenario with periodic partitions, replayed against real
+# verdict-mode fleet workers — a per-node chain/health.py ledger
+# observes every slot past warm-up, a sim-clock TSDB records the full
+# gauge history, and the run ends with the stitched cross-process
+# Chrome trace (worker-pid spans joined to router flows by flow id).
+# Artifacts land in soak_artifacts/ (timeseries JSONL, stitched trace,
+# merged fleet timeseries, HTML/SVG timeline); the `health` section is
+# state-gated round over round by tools/bench_compare.py ("HEALTH
+# DIVERGED"). CONSENSUS_SPECS_TPU_SOAK_* env resizes.
+soak-bench:
+	JAX_PLATFORMS=cpu CONSENSUS_SPECS_TPU_SOAK_DIR=soak_artifacts python bench.py --mode soak
+	python tools/render_timeline.py soak_artifacts/soak_timeseries.jsonl -o soak_artifacts/soak_timeline.html
+
+# soak CI canary: the same pipeline at 26 epochs (~200 slots, well
+# under a minute), with the claims turned into an exit status — health
+# gate green, scenario converged, >= 2 worker pids flow-joined in the
+# stitched trace, one TSDB sample per slot; the timeline render rides
+# along as the uploadable artifact
+soak-smoke:
+	JAX_PLATFORMS=cpu CONSENSUS_SPECS_TPU_SOAK_DIR=soak_artifacts python -m consensus_specs_tpu.sim.soak_smoke
+	python tools/render_timeline.py soak_artifacts/soak_timeseries.jsonl -o soak_artifacts/soak_timeline.html
 
 # end-to-end gossip→head latency matrix (ISSUE 12): latency_skew and
 # lossy_links simnet scenarios, each run under the classic

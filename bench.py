@@ -98,6 +98,19 @@ heal-to-convergence latency, per-node heads/sec, and the fault mix
 (CONSENSUS_SPECS_TPU_SIM_* env knobs; the `sim` section is gated round
 over round by tools/bench_compare.py — a newly diverging scenario fails).
 
+`--mode soak` is the long-horizon telemetry soak (ISSUE 19,
+consensus_specs_tpu/bench/soak.py): a thousand-plus-slot simnet
+scenario (periodic partitions over a linear canonical chain) replayed
+against real verdict-mode fleet workers, with a per-node
+chain/health.py ledger observing every slot past warm-up, a sim-clock
+obs/timeseries.py store recording the full gauge history, and the
+stitched cross-process Chrome trace dumped at the end. The JSON line's
+value is simulated slots/sec of wall time; `vs_baseline` is 1.0 iff the
+health gate (participation floor, bounded finality lag, zero
+unexplained reorgs) held on every node; the `health` section is
+state-gated round over round by tools/bench_compare.py ("HEALTH
+DIVERGED"). CONSENSUS_SPECS_TPU_SOAK_* env knobs size it.
+
 `--mode proofs` is the light-client read-path bench
 (consensus_specs_tpu/bench/proofs.py): 10^4-10^6 simulated clients
 replayed against the ProofService — R distinct per-slot proof artifacts
@@ -578,6 +591,25 @@ def main():
         from consensus_specs_tpu.bench.proofs import run_proofs_bench
 
         _emit_result(run_proofs_bench())
+        return
+
+    if _cli_mode() == "soak":
+        # long-horizon telemetry soak (ISSUE 19): a thousand-plus-slot
+        # simnet scenario against the real fleet deployment shape, a
+        # per-node health ledger observing every slot, a sim-clock TSDB
+        # recording the history, and the stitched cross-process Chrome
+        # trace at the end. CPU-forced and crypto-free (verdict-mode
+        # workers) — the thing measured is the telemetry plane and
+        # fork-choice health over time, not device math. The `health`
+        # section is state-gated round over round by
+        # tools/bench_compare.py ("HEALTH DIVERGED" when a previously
+        # green gate goes red).
+        from consensus_specs_tpu.utils.jax_env import force_cpu
+
+        force_cpu()
+        from consensus_specs_tpu.bench.soak import run_soak_bench
+
+        _emit_result(run_soak_bench())
         return
 
     if _cli_mode() == "merkle":

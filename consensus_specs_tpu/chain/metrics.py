@@ -150,6 +150,23 @@ class ChainMetrics:
 
         _merkle_levels.export_gauges()
 
+    def counters(self) -> Dict[str, int]:
+        """Plain counter reads (no latency-summary build) — what the
+        per-slot health ledger (``chain/health.py``) diffs every slot,
+        where ``snapshot()``'s percentile construction would dominate
+        the slot's own cost at soak horizons."""
+        with self._lock:
+            return {
+                "blocks": self.blocks,
+                "head_changes": self.head_changes,
+                "reorgs": self.reorgs,
+                "last_reorg_depth": self.last_reorg_depth,
+                "head_slot": self.head_slot,
+                "deferred_pending": self.deferred_pending,
+                "speculative_applied": self.speculative_applied,
+                "rollbacks": self.rollbacks,
+            }
+
     def snapshot(self) -> Dict[str, float]:
         lat = profiling.latency_summary().get(self._apply_label, {})
         with self._lock:

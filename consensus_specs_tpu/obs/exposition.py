@@ -13,7 +13,12 @@ Routes:
                   ``ok`` that is the AND over declared objectives, so a
                   probe distinguishes "alive" from "alive and in budget";
   ``/flightdump`` the flight recorder's journal as JSONL
-                  (``obs/flight.py``; 404 when the recorder is disabled).
+                  (``obs/flight.py``; 404 when the recorder is disabled);
+  ``/timeseries`` the rendered time-series rings (``obs/timeseries.py``:
+                  per-resolution points with gauge values and
+                  histogram-delta percentiles; 404 when the TSDB is
+                  disabled). The fleet router overrides this route with
+                  its aggregator's exact cross-worker merge.
 
 Explicitly opt-in: nothing in the serve plane binds a port unless
 ``start_exposition`` is called (the serve bench does it when
@@ -80,6 +85,22 @@ class _Handler(BaseHTTPRequestHandler):
                     body = rec.to_jsonl(
                         reason="flightdump_endpoint").encode()
                 ctype = "application/x-ndjson"
+            elif path == "/timeseries":
+                fn = self.server.timeseries_fn
+                if fn is not None:
+                    payload = fn()
+                else:
+                    from . import timeseries
+
+                    store = timeseries.maybe_store()
+                    if store is None:
+                        self.send_error(
+                            404, "timeseries disabled "
+                            "(set CONSENSUS_SPECS_TPU_TS=1)")
+                        return
+                    payload = store.render()
+                body = json.dumps(payload, sort_keys=True).encode()
+                ctype = "application/json"
             else:
                 self.send_error(404, "unknown path")
                 return
@@ -104,16 +125,17 @@ class ExpositionServer:
 
     def __init__(self, snapshot_fn=None, host: str = "127.0.0.1",
                  port: int = 0, metrics_fn=None, healthz_fn=None,
-                 flight_fn=None):
+                 flight_fn=None, timeseries_fn=None):
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
         self._httpd.snapshot_fn = snapshot_fn or _default_snapshot
         # per-route body overrides (None = this process's default source);
         # the fleet router passes its aggregator's merged render/healthz/
-        # journal so ONE endpoint class serves both shapes
+        # journal/timeseries so ONE endpoint class serves both shapes
         self._httpd.metrics_fn = metrics_fn
         self._httpd.healthz_fn = healthz_fn
         self._httpd.flight_fn = flight_fn
+        self._httpd.timeseries_fn = timeseries_fn
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="obs-exposition",
             daemon=True,
@@ -143,7 +165,7 @@ class ExpositionServer:
 
 def start_exposition(metrics=None, snapshot_fn=None, host: str = "127.0.0.1",
                      port: int = 0, metrics_fn=None, healthz_fn=None,
-                     flight_fn=None) -> ExpositionServer:
+                     flight_fn=None, timeseries_fn=None) -> ExpositionServer:
     """Start the endpoint. ``metrics`` is a ``ServeMetrics`` (its
     ``snapshot`` becomes ``/snapshot``); ``snapshot_fn`` overrides; with
     neither, ``/snapshot`` serves the profiling summary. The ``*_fn``
@@ -152,4 +174,4 @@ def start_exposition(metrics=None, snapshot_fn=None, host: str = "127.0.0.1",
         snapshot_fn = metrics.snapshot
     return ExpositionServer(snapshot_fn=snapshot_fn, host=host, port=port,
                             metrics_fn=metrics_fn, healthz_fn=healthz_fn,
-                            flight_fn=flight_fn)
+                            flight_fn=flight_fn, timeseries_fn=timeseries_fn)

@@ -34,17 +34,24 @@ whether the process behind ``/metrics`` is a lone service or a fleet.
 """
 import json
 import threading
+from collections import deque
 from typing import Dict, List, Optional, Tuple
 
 from . import registry, snapshot
 from .hist import Histogram
 
 # worker gauges under these planes re-scope per worker via node_label
-# (the registered serve[/chain[ dynamic families); everything else is a
-# process-wide counter-style gauge that sums across the fleet
-_INSTANCE_PLANES = ("serve.", "chain.")
+# (the registered serve[/chain[/process[ dynamic families); everything
+# else is a process-wide counter-style gauge that sums across the fleet.
+# process.* is instance state by definition: summing two workers' RSS
+# reports a resident set nobody has
+_INSTANCE_PLANES = ("serve.", "chain.", "process.")
 # recomputed fleet-side from merged histograms, never merged from workers
 _DROP_PREFIXES = ("slo.",)
+
+# per-worker retained completed-trace wires (the stitched Chrome export
+# reads these; the bound matches the worker tracer's own ring)
+_SPAN_RING = 512
 
 
 class FleetAggregator:
@@ -62,15 +69,34 @@ class FleetAggregator:
         self._snaps: Dict[str, Dict] = {}
         self._journal: List[Dict] = []
         self._last_seq: Dict[str, int] = {}
+        self._last_rid: Dict[str, int] = {}
+        # pid of the incarnation the watermarks belong to: a respawned
+        # worker restarts its seq/rid counters from 1, so watermarks
+        # keyed by label alone would silently drop the new process's
+        # entire journal/span stream (ISSUE 19 satellite — the restart
+        # regression test in tests/test_fleet.py pins this)
+        self._pids: Dict[str, int] = {}
+        self._spans: Dict[str, "deque[Dict]"] = {}
         self.ingests = 0
 
     # -- ingest ---------------------------------------------------------------
 
     def ingest(self, worker: str, snap: Dict) -> None:
         """Store ``worker``'s latest snapshot (wire-version-checked) and
-        absorb its new flight events into the merged journal."""
+        absorb its new flight events / trace spans into the merged
+        journal and span store. A snapshot arriving from a NEW pid under
+        a known label is a respawned worker: its watermarks reset to 0
+        first, so the fresh incarnation's restarted sequence numbers
+        merge from the top instead of hiding below the old high water."""
         snapshot.check_version(snap)
+        pid = int(snap.get("pid") or 0)
         with self._lock:
+            prev_pid = self._pids.get(worker)
+            if pid and prev_pid is not None and pid != prev_pid:
+                self._last_seq[worker] = 0
+                self._last_rid[worker] = 0
+            if pid:
+                self._pids[worker] = pid
             self._snaps[worker] = snap
             self.ingests += 1
             flight = snap.get("flight")
@@ -82,15 +108,46 @@ class FleetAggregator:
                         stamped = dict(event)
                         stamped.setdefault("node", worker)
                         stamped["worker"] = worker
+                        stamped["pid"] = pid
                         self._journal.append(stamped)
                         self._last_seq[worker] = seq
+            spans = snap.get("spans")
+            if spans:
+                ring = self._spans.setdefault(worker,
+                                              deque(maxlen=_SPAN_RING))
+                last = self._last_rid.get(worker, 0)
+                for tr in spans.get("traces", ()):
+                    rid = int(tr.get("rid", 0))
+                    if rid > last:
+                        ring.append(dict(tr))
+                        self._last_rid[worker] = rid
+                        last = rid
 
-    def last_seq(self, worker: str) -> int:
+    def _watermark(self, table: Dict[str, int], worker: str,
+                   pid: Optional[int]) -> int:
+        with self._lock:
+            if pid is not None:
+                known = self._pids.get(worker)
+                if known is not None and int(pid) != known:
+                    # the caller is asking on behalf of a NEW incarnation
+                    # the aggregator has not ingested yet: its counters
+                    # start over, so the delta cursor must be 0 — passing
+                    # the old incarnation's high water would make the
+                    # fresh worker ship nothing, forever
+                    return 0
+            return table.get(worker, 0)
+
+    def last_seq(self, worker: str, pid: Optional[int] = None) -> int:
         """Highest flight-event sequence number already merged from
         ``worker`` — the router passes it back as ``flight_since`` so
-        steady-state snapshots ship journal deltas, not the full ring."""
-        with self._lock:
-            return self._last_seq.get(worker, 0)
+        steady-state snapshots ship journal deltas, not the full ring.
+        ``pid`` (the live handle's OS pid) guards the restart race: a
+        pid the aggregator hasn't seen yet answers 0."""
+        return self._watermark(self._last_seq, worker, pid)
+
+    def last_rid(self, worker: str, pid: Optional[int] = None) -> int:
+        """Span-stream analog of :meth:`last_seq` (``spans_since``)."""
+        return self._watermark(self._last_rid, worker, pid)
 
     # -- merged reads ---------------------------------------------------------
 
@@ -196,6 +253,39 @@ class FleetAggregator:
                                                 local_hists)
         return registry.render_prometheus(stats=stats, gauges=gauges,
                                           hists=hists)
+
+    # -- merged time series + spans (ISSUE 19) --------------------------------
+
+    def worker_timeseries_wires(self) -> List[Dict]:
+        """Every worker's latest TSDB wire (workers with the TSDB env
+        unset ship no section and contribute nothing)."""
+        with self._lock:
+            items = sorted(self._snaps.items())
+        return [snap["timeseries"] for _w, snap in items
+                if snap.get("timeseries")]
+
+    def merged_timeseries_wire(self, local_wire: Optional[Dict] = None
+                               ) -> Dict:
+        """ONE fleet-wide time-series wire: the exact merge of every
+        worker's rings plus (when given) the router process's own store
+        — the ``/timeseries`` body. The merge algebra
+        (``obs/timeseries.py``: per-label max-sub wins, ties sum, hist
+        deltas add) makes this bit-identical to a single store that had
+        ingested every process's samples, which is what the split-feed
+        property test pins."""
+        from . import timeseries
+
+        wires = ([local_wire] if local_wire else [])
+        wires += self.worker_timeseries_wires()
+        return timeseries.merge_wires(wires)
+
+    def worker_span_sections(self) -> Dict[str, Dict]:
+        """Per-worker stitching input for ``tracing.stitched_chrome``:
+        ``{label: {"pid": os_pid, "traces": [wire traces]}}``."""
+        with self._lock:
+            return {worker: {"pid": self._pids.get(worker, 0),
+                             "traces": [dict(tr) for tr in ring]}
+                    for worker, ring in self._spans.items() if ring}
 
     # -- merged journal -------------------------------------------------------
 

@@ -196,6 +196,36 @@ GAUGES: Dict[str, str] = {
     "merkle.fallbacks": "Merkleization batch attempts that fell back "
                         "to the pure-python path (native lib missing "
                         "or dynamically-shaped elements)",
+    "health.participation_rate": "attesting balance / total balance in "
+                                 "the proto-array's tables, computed "
+                                 "once per slot (chain/health.py)",
+    "health.head_churn": "head pointer moves observed this slot",
+    "health.reorg_depth": "deepest rollback among this slot's reorgs "
+                          "(0 when the head only extended)",
+    "health.finality_lag_slots": "current slot minus the finalized "
+                                 "checkpoint epoch's start slot (a "
+                                 "healthy chain holds ~2 epochs)",
+    "health.deferral_depth": "deferral-buffer depth at the slot "
+                             "boundary (gossip ahead of its "
+                             "dependencies)",
+    "health.rollback_rate": "speculative batches reverted this slot",
+    "health.unexplained_reorgs": "cumulative reorgs observed outside "
+                                 "declared disruption windows (the "
+                                 "soak gate requires 0)",
+    "timeseries.samples": "fixed-interval samples the time-series "
+                          "store has recorded since process start",
+    "timeseries.points": "points currently retained across every "
+                         "ring level (bounded by "
+                         "CONSENSUS_SPECS_TPU_TS_CAP per level)",
+    "timeseries.evicted": "points dropped by ring eviction (the "
+                          "coarser levels still cover the horizon)",
+    "process.rss_bytes": "resident set size of this process "
+                         "(/proc/self/statm; the soak's memory-leak "
+                         "detector, per worker on the fleet surface)",
+    "process.cpu_s": "user+system CPU seconds consumed by this "
+                     "process (resource.getrusage)",
+    "process.open_fds": "open file descriptors held by this process "
+                        "(/proc/self/fd count; -1 when unreadable)",
 }
 
 STATS: Dict[str, str] = {
@@ -263,6 +293,16 @@ DYNAMIC_PREFIXES: Dict[str, tuple] = {
                                          "labelled lightclient[<node>]."
                                          "<name> — same names as the "
                                          "lightclient.* family"),
+    "health[": ("health_node", "per-node consensus health ledger rows "
+                               "from multi-instance (simnet) runs, "
+                               "labelled health[<node>].<name> — same "
+                               "names as the health.* family"),
+    "process[": ("process_node", "per-worker process resource gauges "
+                                 "on the merged fleet surface, "
+                                 "labelled process[<worker>].<name> — "
+                                 "same names as the process.* family "
+                                 "(resources must never SUM across "
+                                 "workers: each is one process's)"),
 }
 
 

@@ -64,12 +64,73 @@ def hist_from_wire(wire: Dict) -> hist.Histogram:
         raise WireError(f"malformed histogram wire dict: {e}") from e
 
 
+# -- per-process resource gauges (ISSUE 19 satellite) -------------------------
+
+# the gauge family every snapshot refreshes (drift-gated like the rest:
+# registered in obs/registry.py, documented in the README metric table).
+# Resources are INSTANCE state — the fleet surface republishes them as
+# `process[<worker>].<name>`, never summed across workers.
+PROCESS_GAUGE_LABELS = (
+    "process.rss_bytes",
+    "process.cpu_s",
+    "process.open_fds",
+)
+
+
+def read_process_resources() -> Dict[str, float]:
+    """Current resident set, cumulative CPU seconds, and open fd count
+    for THIS process. Linux-first (/proc), degrading gracefully: RSS
+    falls back to ``getrusage`` peak-RSS where /proc is absent, fd count
+    reports -1 where it cannot be read (macOS without /proc)."""
+    import resource
+
+    rss = -1.0
+    try:
+        with open("/proc/self/statm", "r", encoding="ascii") as f:
+            pages = int(f.read().split()[1])
+        rss = float(pages * os.sysconf("SC_PAGE_SIZE"))
+    except (OSError, ValueError, IndexError):
+        try:
+            # ru_maxrss: peak, in KiB on Linux / bytes on macOS — only a
+            # fallback; the /proc path above reports CURRENT rss
+            import sys
+
+            ru = resource.getrusage(resource.RUSAGE_SELF)
+            scale = 1 if sys.platform == "darwin" else 1024
+            rss = float(ru.ru_maxrss * scale)
+        except (OSError, ValueError):
+            pass
+    ru = resource.getrusage(resource.RUSAGE_SELF)
+    cpu_s = float(ru.ru_utime + ru.ru_stime)
+    try:
+        fds = float(len(os.listdir("/proc/self/fd")))
+    except OSError:
+        fds = -1.0
+    return {
+        "process.rss_bytes": rss,
+        "process.cpu_s": cpu_s,
+        "process.open_fds": fds,
+    }
+
+
+def export_process_gauges() -> Dict[str, float]:
+    """Refresh the ``process.*`` family onto the profiling surface (and
+    so into this snapshot's gauge dict and every TSDB sample)."""
+    from ..ops import profiling
+
+    values = read_process_resources()
+    for label in PROCESS_GAUGE_LABELS:
+        profiling.set_gauge(label, values[label])
+    return values
+
+
 # -- whole-process snapshot ---------------------------------------------------
 
 
 def take_process_snapshot(worker: Optional[str] = None,
                           extra: Optional[Dict] = None,
-                          flight_since: int = 0) -> Dict:
+                          flight_since: int = 0,
+                          spans_since: int = 0) -> Dict:
     """The process's full observability state as one JSON-safe dict:
     latency histograms (wire form), stat accumulators, gauges, and — when
     the flight recorder is armed — the journal ring with its counters.
@@ -78,11 +139,18 @@ def take_process_snapshot(worker: Optional[str] = None,
     ``flight_since`` ships only flight events with ``seq`` past it (the
     fleet control tick passes its last merged seq so the steady-state
     snapshot carries deltas, not the whole 4096-event ring — counters
-    stay cumulative either way)."""
+    stay cumulative either way); ``spans_since`` does the same for
+    completed trace spans (rid-delta'd) when tracing is armed.
+
+    Three sections are armed-only (ISSUE 19): ``process.*`` resource
+    gauges refresh into the gauge dict unconditionally (they cost three
+    /proc reads), the ``timeseries`` section rides when the TSDB env is
+    set, and the ``spans`` section rides when tracing is enabled."""
     from ..ops import profiling
 
-    from . import flight
+    from . import flight, timeseries, tracing
 
+    export_process_gauges()
     stats, gauges = profiling.stats_and_gauges()
     snap = {
         "v": WIRE_VERSION,
@@ -102,6 +170,15 @@ def take_process_snapshot(worker: Optional[str] = None,
         snap["flight"] = {
             "counters": rec.counters(),
             "events": events,
+        }
+    store = timeseries.maybe_store()
+    if store is not None:
+        snap["timeseries"] = store.to_wire()
+    tracer = tracing.maybe_tracer()
+    if tracer is not None:
+        snap["spans"] = {
+            "since": int(spans_since),
+            "traces": tracing.wire_spans(tracer, spans_since),
         }
     if extra:
         snap["extra"] = extra

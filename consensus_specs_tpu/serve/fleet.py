@@ -229,17 +229,35 @@ class WorkerHandle:
     def alive(self) -> bool:
         return self._proc.poll() is None
 
-    def submit(self, kind: str, pubkeys, messages, signature
-               ) -> "Future[bool]":
+    @property
+    def pid(self) -> int:
+        """The worker's OS pid — the aggregator's incarnation key (a
+        respawned label is a new pid, which is how the seq/rid
+        watermarks know to reset, ISSUE 19)."""
+        return self._proc.pid
+
+    def submit(self, kind: str, pubkeys, messages, signature,
+               birth_s: Optional[float] = None,
+               flow_id: Optional[int] = None) -> "Future[bool]":
+        """``birth_s``/``flow_id`` ride the wire (ISSUE 19): the worker
+        passes them to its service's submit, so the gossip→head ingress
+        latency and the Chrome flow id survive the process boundary —
+        the worker-side flow START and the router-side chain FINISH
+        carry the same id and stitch into one arrow."""
         req_id, fut = self._alloc(self._results)
         if kind == "fast_aggregate":
             wire_messages = bytes(messages).hex()
         else:
             wire_messages = [bytes(m).hex() for m in messages]
-        self._send({"op": "submit", "id": req_id, "kind": kind,
-                    "pubkeys": [bytes(pk).hex() for pk in pubkeys],
-                    "messages": wire_messages,
-                    "signature": bytes(signature).hex()})
+        msg = {"op": "submit", "id": req_id, "kind": kind,
+               "pubkeys": [bytes(pk).hex() for pk in pubkeys],
+               "messages": wire_messages,
+               "signature": bytes(signature).hex()}
+        if birth_s is not None:
+            msg["birth"] = float(birth_s)
+        if flow_id is not None:
+            msg["flow"] = int(flow_id)
+        self._send(msg)
         return fut
 
     def rpc(self, obj: Dict, timeout: Optional[float] = 60.0) -> Dict:
@@ -248,13 +266,15 @@ class WorkerHandle:
         return fut.result(timeout=timeout)
 
     def snapshot(self, timeout: Optional[float] = 60.0,
-                 flight_since: int = 0) -> Dict:
+                 flight_since: int = 0, spans_since: int = 0) -> Dict:
         """``flight_since`` asks the worker to ship only flight events
         past that sequence number (the aggregator dedups by seq anyway —
         this keeps the steady-state control tick from re-piping the full
-        4096-event ring every second)."""
+        4096-event ring every second); ``spans_since`` is the same delta
+        cursor for completed trace spans (rid-keyed)."""
         return self.rpc({"op": "snapshot",
-                         "flight_since": int(flight_since)},
+                         "flight_since": int(flight_since),
+                         "spans_since": int(spans_since)},
                         timeout=timeout)["data"]
 
     def set_rung(self, rung: int, reason: str = "fleet_shed",
@@ -340,10 +360,15 @@ class FleetRouter:
         pin = os.environ.get(PIN_ENV, "1") != "0"
         slices = (_core_slices(len(self._labels)) if pin
                   else [None] * len(self._labels))
+        # per-label spawn recipe, kept for respawn(): a crashed worker
+        # comes back with the same backend/env/core slice it launched with
+        self._backend = backend
+        self._spawn_env: Dict[str, Dict[str, str]] = {}
         for label, cores in zip(self._labels, slices):
             worker_env = dict(env or {})
             if cores is not None:
                 worker_env.setdefault(CPU_ENV, cores)
+            self._spawn_env[label] = worker_env
             handle = WorkerHandle(label, env=worker_env, backend=backend)
             self._handles[label] = handle
             if self._recorder is not None:
@@ -374,8 +399,9 @@ class FleetRouter:
     def handle(self, label: str) -> WorkerHandle:
         return self._handles[label]
 
-    def submit(self, kind: str, pubkeys, messages, signature
-               ) -> "Future[bool]":
+    def submit(self, kind: str, pubkeys, messages, signature,
+               birth_s: Optional[float] = None,
+               flow_id: Optional[int] = None) -> "Future[bool]":
         if self._closed:
             raise WorkerProtocolError("submit() on a closed FleetRouter")
         key = check_key(kind, [bytes(pk) for pk in pubkeys],
@@ -386,7 +412,8 @@ class FleetRouter:
         with self._lock:
             self.requests += 1
         return self._handles[label].submit(kind, pubkeys, messages,
-                                           signature)
+                                           signature, birth_s=birth_s,
+                                           flow_id=flow_id)
 
     # -- control plane --------------------------------------------------------
 
@@ -397,9 +424,16 @@ class FleetRouter:
         out = {}
         for label in self.live_workers:
             try:
-                snap = self._handles[label].snapshot(
+                handle = self._handles[label]
+                # the handle's live pid guards the delta cursors across a
+                # respawn: a fresh incarnation's counters restart, so the
+                # aggregator answers 0 until it has ingested that pid
+                snap = handle.snapshot(
                     timeout=timeout,
-                    flight_since=self.aggregator.last_seq(label))
+                    flight_since=self.aggregator.last_seq(
+                        label, pid=handle.pid),
+                    spans_since=self.aggregator.last_rid(
+                        label, pid=handle.pid))
                 self.aggregator.ingest(label, snap)
                 out[label] = snap
             except Exception as e:
@@ -489,14 +523,50 @@ class FleetRouter:
         self._ring.remove(label)
         self._rungs.pop(label, None)
         try:
-            self.aggregator.ingest(label, self._handles[label].snapshot(
-                timeout=30, flight_since=self.aggregator.last_seq(label)))
+            handle = self._handles[label]
+            self.aggregator.ingest(label, handle.snapshot(
+                timeout=30,
+                flight_since=self.aggregator.last_seq(
+                    label, pid=handle.pid),
+                spans_since=self.aggregator.last_rid(
+                    label, pid=handle.pid)))
         except Exception:
             pass  # the last periodic snapshot stands
         self._handles[label].close(timeout=timeout)
         if self._recorder is not None:
             self._recorder.note("fleet", "worker_drained", worker=label)
         self._export_gauges()
+
+    def respawn(self, label: str, spawn_timeout: float = 180.0
+                ) -> WorkerHandle:
+        """Bring a crashed (or reaped) worker label back: spawn a fresh
+        process with the label's original backend/env/core recipe and
+        re-home its hash arc. The NEW pid is what tells the aggregator's
+        seq/rid watermarks to reset — the respawned journal and span
+        streams merge from their restarted counters instead of being
+        silently dropped below the dead incarnation's high water
+        (ISSUE 19 satellite; the restart regression test pins the merge)."""
+        old = self._handles.get(label)
+        if old is not None and old.alive:
+            raise WorkerProtocolError(
+                f"respawn({label!r}): worker is still alive — drain it "
+                f"or let _reap_dead evict it first")
+        handle = WorkerHandle(label, env=self._spawn_env.get(label, {}),
+                              backend=self._backend)
+        if not handle.ready.wait(spawn_timeout):
+            handle.close(timeout=10)
+            raise WorkerProtocolError(
+                f"respawned worker {label} not ready within "
+                f"{spawn_timeout:.0f}s")
+        self._handles[label] = handle
+        self._ring.remove(label)  # no-op when already reaped
+        self._ring.add(label)
+        self._rungs[label] = 0
+        if self._recorder is not None:
+            self._recorder.note("fleet", "worker_respawned", worker=label,
+                                worker_pid=handle.pid)
+        self._export_gauges()
+        return handle
 
     def start_control(self, interval_s: float = 1.0) -> None:
         """Background control loop (bench/production mode; tests and the
@@ -561,10 +631,34 @@ class FleetRouter:
         return self.aggregator.journal_jsonl(local_recorder=self._recorder,
                                              reason=reason)
 
+    def timeseries_doc(self) -> Dict:
+        """The fleet-wide ``/timeseries`` body: every worker's TSDB wire
+        merged exactly with the router's own store (when armed), then
+        rendered (percentiles computed on the MERGED histogram deltas —
+        fleet p99s, not averaged worker p99s)."""
+        from ..obs import timeseries
+
+        store = timeseries.maybe_store()
+        merged = self.aggregator.merged_timeseries_wire(
+            local_wire=store.to_wire() if store is not None else None)
+        return timeseries.render_wire(merged)
+
+    def dump_trace(self, path: str) -> str:
+        """ONE stitched Chrome trace: the router's own lanes (pipeline /
+        vm / devices / flight journal) plus every worker's request spans
+        on per-worker pids, flow ids joined across the process boundary
+        (ISSUE 19 — load it in Perfetto and the arrow from a worker's
+        signature verdict lands on the router-side head move)."""
+        from ..obs import tracing
+
+        return tracing.dump_stitched_trace(
+            path, self.aggregator.worker_span_sections())
+
     def start_exposition(self, port: int = 0):
         """The fleet's merged exposition endpoint: ``/metrics`` renders
         the aggregator's cross-process merge, ``/healthz`` the fleet SLO
-        state, ``/flightdump`` the merged journal."""
+        state, ``/flightdump`` the merged journal, ``/timeseries`` the
+        merged time-series rings."""
         from ..obs.exposition import start_exposition
 
         return start_exposition(
@@ -579,7 +673,8 @@ class FleetRouter:
                 "fleet": {"requests": self.requests, "sheds": self.sheds,
                           "drains": self.drains,
                           "live": self.live_workers},
-            })
+            },
+            timeseries_fn=self.timeseries_doc)
 
     # -- lifecycle ------------------------------------------------------------
 

@@ -738,6 +738,13 @@ class VerificationService:
             self.metrics.note_fallback(len(pends))
             return [self._oracle_one(p) for p in pends]
         backend = self._resolve_backend()
+        # cross-process flow stitching (ISSUE 19): a backend that declares
+        # ``wants_flow_context`` (the fleet replay's router adapter) gets
+        # each item's Chrome flow id alongside the batch, so the worker
+        # process's spans join the same gossip→head flow this service's
+        # traces already carry — no signature change for every other
+        # backend
+        wants_flows = bool(getattr(backend, "wants_flow_context", False))
         last_err = None
         for attempt in range(1 + self._backend_retries):
             if attempt:
@@ -754,6 +761,10 @@ class VerificationService:
             group_mesh = self._flush_mesh(len(pends)) if attempt == 0 else None
             if group_mesh is not None:
                 kwargs["mesh"] = group_mesh
+            if wants_flows:
+                kwargs["flows"] = [
+                    None if p.trace is None else p.trace.flow
+                    for p in pends]
             try:
                 if kind == "fast_aggregate":
                     res = backend.batch_fast_aggregate_verify(

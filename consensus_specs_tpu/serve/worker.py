@@ -204,12 +204,19 @@ def _decode_submit(msg):
 def main() -> int:
     _apply_affinity()
     label = os.environ.get(WORKER_ENV, f"w{os.getpid()}")
-    from ..obs import snapshot
+    from ..obs import snapshot, timeseries
     from ..utils import bls
 
     # verdicts must flow through the service, not the stub's eager True
     bls.bls_active = True
     svc, backend = _build_service(label)
+
+    # telemetry plane (ISSUE 19): when the TSDB env is set (inherited
+    # from the router), sample this worker's gauges/histograms on the
+    # configured interval — the rings ship home in every snapshot and
+    # merge exactly in the aggregator
+    sampler = (timeseries.start_sampler() if timeseries.ts_enabled()
+               else None)
 
     out_lock = threading.Lock()
 
@@ -241,7 +248,12 @@ def main() -> int:
                 req_id = msg.get("id")
                 if op == "submit":
                     kind, pubkeys, messages, signature = _decode_submit(msg)
-                    fut = svc.submit(kind, pubkeys, messages, signature)
+                    birth = msg.get("birth")
+                    flow = msg.get("flow")
+                    fut = svc.submit(
+                        kind, pubkeys, messages, signature,
+                        birth_s=None if birth is None else float(birth),
+                        flow_id=None if flow is None else int(flow))
                     fut.add_done_callback(on_done(req_id))
                 elif op == "snapshot":
                     data = snapshot.take_process_snapshot(
@@ -249,7 +261,8 @@ def main() -> int:
                         extra={"serve": svc.metrics.snapshot(),
                                "ladder_rung": svc.ladder_rung,
                                "faults_fired": backend.fired},
-                        flight_since=int(msg.get("flight_since", 0)))
+                        flight_since=int(msg.get("flight_since", 0)),
+                        spans_since=int(msg.get("spans_since", 0)))
                     send({"op": "snapshot", "id": req_id, "data": data})
                 elif op == "ladder":
                     svc.set_ladder_rung(int(msg["rung"]),
@@ -279,6 +292,8 @@ def main() -> int:
                       if isinstance(msg, dict) else None,
                       "error": f"{type(e).__name__}: {e}"[:200]})
     finally:
+        if sampler is not None:
+            sampler.close()
         svc.close(timeout=60)
         try:
             send({"op": "bye"})

@@ -32,6 +32,14 @@ class FleetVerdictBackend:
     the same ``calls``/``items`` ledger as `VerdictBackend`, so node
     snapshots keep reporting backend activity."""
 
+    # cross-process flow stitching (ISSUE 19): the node-side
+    # VerificationService hands this backend each item's Chrome flow id
+    # (serve/service.py honors the declaration below), and the router
+    # forwards it over the worker protocol — so the WORKER process's
+    # request spans carry the same flow id the node-side serve/chain
+    # traces emit, and the stitched fleet trace joins them across pids
+    wants_flow_context = True
+
     def __init__(self, router, node: Optional[str] = None,
                  timeout: float = 120.0):
         self._router = router
@@ -40,22 +48,28 @@ class FleetVerdictBackend:
         self.calls = 0
         self.items = 0
 
-    def _route(self, kind, pubkey_sets, message_likes, signatures):
+    def _route(self, kind, pubkey_sets, message_likes, signatures,
+               flows=None):
         self.calls += 1
         self.items += len(signatures)
+        if flows is None:
+            flows = [None] * len(signatures)
         futures = [
-            self._router.submit(kind, pks, msg, sig)
-            for pks, msg, sig in zip(pubkey_sets, message_likes, signatures)
+            self._router.submit(kind, pks, msg, sig, flow_id=fid)
+            for pks, msg, sig, fid in zip(pubkey_sets, message_likes,
+                                          signatures, flows)
         ]
         return [bool(f.result(timeout=self._timeout)) for f in futures]
 
-    def batch_fast_aggregate_verify(self, pubkey_sets, messages, signatures):
+    def batch_fast_aggregate_verify(self, pubkey_sets, messages, signatures,
+                                    flows=None):
         return self._route("fast_aggregate", pubkey_sets, messages,
-                           signatures)
+                           signatures, flows=flows)
 
-    def batch_aggregate_verify(self, pubkey_sets, message_sets, signatures):
+    def batch_aggregate_verify(self, pubkey_sets, message_sets, signatures,
+                               flows=None):
         return self._route("aggregate", pubkey_sets, message_sets,
-                           signatures)
+                           signatures, flows=flows)
 
 
 def run_fleet_replay(scenario: str = "partition_heal", *, workers: int = 2,

@@ -331,7 +331,8 @@ def run_scenario(scenario: Scenario, *, spec=None, anchor_state=None,
                  backend_factory=None,
                  service_kwargs: Optional[dict] = None,
                  head_kwargs: Optional[dict] = None,
-                 light_clients: Optional[int] = None) -> ScenarioReport:
+                 light_clients: Optional[int] = None,
+                 slot_hook=None) -> ScenarioReport:
     """Run one scenario end to end and gate it. ``strict`` raises
     :class:`SimDivergence` on any convergence failure; bench mode passes
     ``strict=False`` and reads ``report.converged``/``report.error``.
@@ -342,7 +343,11 @@ def run_scenario(scenario: Scenario, *, spec=None, anchor_state=None,
     speculative-apply A/B runs) — the scenario script and the gate are
     untouched by either. ``light_clients`` overrides the scenario's
     read-only light-client count (they fetch proofs OUTSIDE the event
-    queue, so the determinism digest is unchanged)."""
+    queue, so the determinism digest is unchanged). ``slot_hook``
+    (ISSUE 19) is called as ``slot_hook(slot, sim_nodes)`` once per
+    simulated slot boundary in slot order — the soak's per-slot health
+    ledger sampling point. Pure reads only: the hook runs outside the
+    event queue and must not publish, so the digest is unchanged."""
     from ..utils import bls
 
     if spec is None:
@@ -433,16 +438,30 @@ def run_scenario(scenario: Scenario, *, spec=None, anchor_state=None,
         samples: List[Tuple[float, bool]] = []
         last_heal = 0.0
         deliveries = 0
+        last_hook_slot = 0
 
         def heads_equal() -> bool:
             head0 = sim_nodes[0].get_head()
             return all(n.get_head() == head0 for n in sim_nodes[1:])
+
+        def fire_slot_hook(up_to_t: float) -> None:
+            # every crossed slot boundary fires exactly once, in order —
+            # a quiet stretch (no events for several slots) still
+            # produces one health row per slot
+            nonlocal last_hook_slot
+            if slot_hook is None:
+                return
+            cur = int(up_to_t // sps)
+            while last_hook_slot < cur:
+                last_hook_slot += 1
+                slot_hook(last_hook_slot, sim_nodes)
 
         while True:
             ev = queue.pop()
             if ev is None:
                 break
             clock_box["now"] = ev.time
+            fire_slot_hook(ev.time)
             digest.update(f"{ev.time:.6f}|{ev.kind}".encode())
             if ev.kind == "publish":
                 origin, msg = ev.data["origin"], ev.data["msg"]
@@ -491,6 +510,7 @@ def run_scenario(scenario: Scenario, *, spec=None, anchor_state=None,
         clock_box["now"] = t_final
         for node in sim_nodes:
             node.advance_clock(t_final)
+        fire_slot_hook(t_final)
         samples.append((t_final, heads_equal()))
         # the final proof round: with heads settled, every client's
         # proof-backed head must land on THE head (gate layer 5)

@@ -1007,3 +1007,84 @@ def test_merkle_extract_shapes(bc):
         "cpu:merkle:state_cold": {"ok": True, "speedup": 6.01}}
     assert bc.extract_merkle({"parsed": {"error": "boom"}}) == {}
     assert bc.extract_merkle({"parsed": _parsed(300.0)}) == {}
+
+
+# -- consensus-health state gate (ISSUE 19) ----------------------------------
+
+
+def _health_parsed(value, ok, pmin, reorgs=0, per_node=None, **extra):
+    """A `--mode soak` line: the ledger's gate verdict + aggregate
+    summary (and optional per-node summaries) under ``health``."""
+    summary = {"participation_min": pmin, "unexplained_reorgs": reorgs}
+    return _parsed(value, mode="soak", n=None, k=None,
+                   health={"gate": {"ok": ok, "reasons": [],
+                                    "summary": summary},
+                           "aggregate": summary,
+                           "per_node": per_node or {}},
+                   **extra)
+
+
+def test_health_newly_diverged_gate_fails(tmp_path, bc, capsys):
+    """A soak whose health gate held last round and reports DIVERGED now
+    fails outright — slow-burn consensus regressions are correctness,
+    not perf jitter."""
+    _write_round(tmp_path, 1, _health_parsed(160.0, True, 0.84))
+    _write_round(tmp_path, 2, _health_parsed(160.0, False, 0.41,
+                                             reorgs=2))
+    assert bc.main(["--dir", str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "cpu:health:aggregate" in out and "HEALTH DIVERGED" in out
+
+
+def test_health_participation_jitter_within_green_gate_passes(
+        tmp_path, bc, capsys):
+    _write_round(tmp_path, 1, _health_parsed(160.0, True, 0.92))
+    _write_round(tmp_path, 2, _health_parsed(160.0, True, 0.78))
+    assert bc.main(["--dir", str(tmp_path)]) == 0
+    assert "0.9200 -> 0.7800" in capsys.readouterr().out
+
+
+def test_health_still_diverged_is_not_a_new_failure(tmp_path, bc):
+    _write_round(tmp_path, 1, _health_parsed(160.0, False, 0.41))
+    _write_round(tmp_path, 2, _health_parsed(160.0, False, 0.40))
+    assert bc.main(["--dir", str(tmp_path)]) == 0
+
+
+def test_health_per_node_rows_inherit_aggregate_verdict(bc):
+    doc = {"parsed": _health_parsed(
+        160.0, True, 0.84,
+        per_node={"n0": {"participation_min": 0.84,
+                         "unexplained_reorgs": 0},
+                  "n1": {"participation_min": 0.9,
+                         "unexplained_reorgs": 0}})}
+    rows = bc.extract_health(doc)
+    assert set(rows) == {"cpu:health:aggregate", "cpu:health:n0",
+                         "cpu:health:n1"}
+    assert rows["cpu:health:n0"] == {"ok": True, "participation_min": 0.84,
+                                     "unexplained_reorgs": 0}
+    assert bc.extract_health({"parsed": {"error": "boom"}}) == {}
+    assert bc.extract_health({"parsed": _parsed(300.0)}) == {}
+
+
+def test_headline_trajectory_spans_every_round(tmp_path, bc, capsys):
+    """The all-rounds trajectory: the markdown summary traces the
+    headline across r01→r03, not just the newest pair."""
+    _write_round(tmp_path, 1, _parsed(300.0))
+    _write_round(tmp_path, 2, _parsed(330.0))
+    _write_round(tmp_path, 3, _parsed(360.0))
+    assert bc.main(["--dir", str(tmp_path)]) == 0
+    files = bc.round_files(str(tmp_path))
+    lines = bc.headline_trajectory(files)
+    assert len(lines) == 1
+    assert "r01 300" in lines[0] and "r03 360" in lines[0]
+    assert "+20.0% over 3 rounds" in lines[0]
+    out = capsys.readouterr().out
+    assert "Headline trajectory (all rounds)" in out
+
+
+def test_headline_trajectory_skips_single_round_keys(tmp_path, bc):
+    _write_round(tmp_path, 1, _parsed(300.0))
+    _write_round(tmp_path, 2, _parsed(310.0, mode="soak", n=None, k=None))
+    files = bc.round_files(str(tmp_path))
+    # committee[32x128] and soak each appear once: nothing to trace
+    assert bc.headline_trajectory(files) == []

@@ -31,13 +31,16 @@ def _pk(i):
     return bytes([i]) * 48
 
 
-def _snap(worker, hists=None, gauges=None, stats=None, events=None):
-    snap = {"v": osnap.WIRE_VERSION, "worker": worker, "pid": 1,
+def _snap(worker, hists=None, gauges=None, stats=None, events=None,
+          pid=1, spans=None):
+    snap = {"v": osnap.WIRE_VERSION, "worker": worker, "pid": pid,
             "hists": hists or {}, "gauges": gauges or {},
             "stats": stats or {}}
     if events is not None:
         snap["flight"] = {"counters": {"events": len(events)},
                           "events": events}
+    if spans is not None:
+        snap["spans"] = {"traces": spans}
     return snap
 
 
@@ -181,6 +184,51 @@ def test_aggregator_journal_is_incremental_and_worker_stamped():
     jsonl = aggr.journal_jsonl(reason="test")
     header = json.loads(jsonl.splitlines()[0])
     assert header["events"] == 3 and header["workers"] == ["w0"]
+
+
+def _ev(seq, t=None):
+    return {"seq": seq, "t": t if t is not None else seq / 10.0,
+            "plane": "serve", "kind": "flush", "data": {}}
+
+
+def test_aggregator_restart_resets_watermarks_and_keeps_both_journals():
+    """The ISSUE 19 restart regression: a respawned worker restarts its
+    flight seq / trace rid counters from 1. Watermarks keyed by label
+    alone would hide the fresh incarnation's entire journal and span
+    stream below the dead process's high water; pid-keyed watermarks
+    reset, and the merged journal keeps BOTH incarnations' events."""
+    aggr = FleetAggregator()
+    aggr.ingest("w0", _snap("w0", pid=100, events=[_ev(1), _ev(2), _ev(3)],
+                            spans=[{"rid": 1, "spans": []},
+                                   {"rid": 2, "spans": []}]))
+    assert aggr.last_seq("w0", pid=100) == 3
+    assert aggr.last_rid("w0", pid=100) == 2
+    # the router asks on behalf of a pid the aggregator has never seen
+    # (the respawn just happened): the delta cursors MUST answer 0 —
+    # answering 3 would make the new worker ship nothing, forever
+    assert aggr.last_seq("w0", pid=200) == 0
+    assert aggr.last_rid("w0", pid=200) == 0
+    # the new incarnation's restarted sequence numbers merge from the top
+    aggr.ingest("w0", _snap("w0", pid=200, events=[_ev(1, t=9.1),
+                                                   _ev(2, t=9.2)],
+                            spans=[{"rid": 1, "spans": []}]))
+    events = aggr.journal_events()
+    assert [e["seq"] for e in events] == [1, 2, 3, 1, 2]
+    assert [e["pid"] for e in events] == [100, 100, 100, 200, 200]
+    assert aggr.last_seq("w0", pid=200) == 2
+    assert aggr.last_rid("w0", pid=200) == 1
+    # span sections carry the LIVE incarnation's pid
+    assert aggr.worker_span_sections()["w0"]["pid"] == 200
+
+
+def test_aggregator_same_pid_reingest_still_dedupes():
+    # the restart reset must not break the normal incremental contract:
+    # the same incarnation re-shipping its ring dedupes by seq
+    aggr = FleetAggregator()
+    aggr.ingest("w0", _snap("w0", pid=100, events=[_ev(1), _ev(2)]))
+    aggr.ingest("w0", _snap("w0", pid=100, events=[_ev(1), _ev(2),
+                                                   _ev(3)]))
+    assert [e["seq"] for e in aggr.journal_events()] == [1, 2, 3]
 
 
 def test_aggregator_rejects_wrong_wire_version():

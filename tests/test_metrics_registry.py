@@ -195,6 +195,49 @@ def test_node_labelled_bases_cover_the_bare_families():
         assert base in emitted
 
 
+def test_telemetry_gauge_families_are_complete():
+    # the continuous-telemetry plane (ISSUE 19): the health.* family must
+    # track chain/health.GAUGE_LABELS one-to-one (export_gauges zips the
+    # tuple — a gauge outside it silently never exports), the TSDB's own
+    # timeseries.* health and the snapshot's process.* resource family
+    # must each match emitted-vs-registered exactly
+    from consensus_specs_tpu.chain import health as chain_health
+    from consensus_specs_tpu.obs import snapshot as obs_snapshot
+
+    emitted = _emitted_labels()
+    for prefix in ("health.", "timeseries.", "process."):
+        family_emitted = {l for l in emitted if l.startswith(prefix)}
+        family_registered = {n for n in registry.GAUGES
+                             if n.startswith(prefix)}
+        assert family_emitted == family_registered, (
+            f"{prefix}* gauge drift: emitted-not-registered="
+            f"{family_emitted - family_registered}, "
+            f"registered-not-emitted={family_registered - family_emitted}"
+        )
+    assert set(chain_health.GAUGE_LABELS) == \
+        {n for n in registry.GAUGES if n.startswith("health.")}, \
+        "chain/health.GAUGE_LABELS and registered health.* diverged"
+    assert set(obs_snapshot.PROCESS_GAUGE_LABELS) == \
+        {n for n in registry.GAUGES if n.startswith("process.")}, \
+        "snapshot.PROCESS_GAUGE_LABELS and registered process.* diverged"
+
+
+def test_telemetry_node_labelled_families_registered():
+    # the per-instance forms (health[<node>].<name> from N simnet
+    # ledgers, process[<worker>].<name> from the fleet merge) are
+    # registered dynamic families and resolve through known()
+    assert "health[" in registry.DYNAMIC_PREFIXES
+    assert "process[" in registry.DYNAMIC_PREFIXES
+    for label in ("health[n0].participation_rate",
+                  "health[n3].finality_lag_slots",
+                  "process[w0].rss_bytes", "process[w1].cpu_s"):
+        assert registry.known(label), f"{label} not resolvable"
+    assert registry.node_label("health.head_churn", "n1") == \
+        "health[n1].head_churn"
+    src = open(os.path.join(_PKG, "chain", "health.py")).read()
+    assert "node_label(" in src, "health.py lost its node_label route"
+
+
 def test_span_stage_registry_matches_tracing_exports():
     # obs/registry.SPAN_STAGES is the canonical stage list; tracing
     # re-exports it — the coverage gate in tests/test_obs.py holds every

@@ -23,7 +23,15 @@ from consensus_specs_tpu.obs import devices, flight, slo
 from consensus_specs_tpu.obs import programs as obs_programs
 from consensus_specs_tpu.obs import registry, tracing
 from consensus_specs_tpu.obs.exposition import start_exposition
-from consensus_specs_tpu.obs.tracing import CHAIN_STAGES, STAGES, Tracer
+from consensus_specs_tpu.obs.tracing import (
+    CHAIN_STAGES,
+    STAGES,
+    WORKER_PID_BASE,
+    Tracer,
+    stitched_chrome,
+    trace_to_wire,
+    wire_spans,
+)
 from consensus_specs_tpu.ops import profiling
 from consensus_specs_tpu.serve import VerificationService
 from consensus_specs_tpu.serve.metrics import ServeMetrics
@@ -189,7 +197,11 @@ def _golden_tracer():
     tr.span(chain, "apply", 0.014, 0.015)
     tr.span(chain, "sweep", 0.015, 0.016)
     tr.span(chain, "head", 0.016, 0.017)
-    chain.flows = (7,)
+    # flow 7 is the router-local serve request above; 8 and 9 were
+    # forwarded over the worker protocol and STARTED on worker pids
+    # (_golden_worker_sections) — the chain batch finishes all three
+    # (ISSUE 19: flow ids survive the process boundary)
+    chain.flows = (7, 8, 9)
     tr.finish(chain, True, t_done=0.017)
     obs_programs.note_assembly("hard_part[k=0,fold=32]", n_steps=4864,
                                n_regs=1024, seconds=1.5,
@@ -198,6 +210,34 @@ def _golden_tracer():
                                n_regs=960, seconds=0.0123,
                                disk_cache_hit=True)
     return tr
+
+
+def _golden_worker_sections():
+    """Deterministic per-worker span sections (the shape
+    ``FleetAggregator.worker_span_sections`` returns): two workers, one
+    request each, every serve stage present, each carrying the flow id
+    the router forwarded (8 and 9 — terminated by the chain batch in
+    ``_golden_tracer``). w0's submit predates the router tracer's epoch,
+    so the stitch's origin-rewind is part of the golden too."""
+
+    def wire(rid, flow, t0):
+        return {
+            "rid": rid, "kind": "fast_aggregate", "n_keys": 2,
+            "t_submit": t0, "ok": True, "pinned": False,
+            "total_s": 0.0035, "flow": flow, "flows": [],
+            "spans": [["queue_wait", t0, t0 + 0.001],
+                      ["prep", t0 + 0.001, t0 + 0.0015],
+                      ["combine", t0 + 0.002, t0 + 0.0025],
+                      ["device", t0 + 0.0015, t0 + 0.003],
+                      ["finalize", t0 + 0.003, t0 + 0.0035]],
+        }
+
+    return {"w0": {"pid": 4242, "traces": [wire(1, 8, 0.0005)]},
+            "w1": {"pid": 4243, "traces": [wire(1, 9, 0.003)]}}
+
+
+def _golden_stitched():
+    return stitched_chrome(_golden_tracer(), _golden_worker_sections())
 
 
 def test_chrome_export_schema():
@@ -222,14 +262,15 @@ def test_chrome_export_schema():
     assert set(CHAIN_STAGES) <= names
     assert "ingress" in names and "head" in names
     assert any(n.startswith("vm[steps=256") for n in names)
-    # the flow arrow: ONE start (the serve request's finalize) and ONE
-    # finish (the chain batch's head stage) sharing id 7, start <= finish
+    # the flow arrows: ONE local start (the serve request's finalize) and
+    # a finish per absorbed flow id on the chain batch's head stage —
+    # ids 8/9 get their starts from worker pids in the STITCHED export
     starts = [e for e in flow_events if e["ph"] == "s"]
     finishes = [e for e in flow_events if e["ph"] == "f"]
-    assert len(starts) == 1 and len(finishes) == 1
-    assert starts[0]["id"] == finishes[0]["id"] == 7
-    assert starts[0]["ts"] <= finishes[0]["ts"]
-    assert finishes[0]["bp"] == "e"
+    assert len(starts) == 1 and starts[0]["id"] == 7
+    assert sorted(e["id"] for e in finishes) == [7, 8, 9]
+    assert all(starts[0]["ts"] <= e["ts"] and e["bp"] == "e"
+               for e in finishes)
     reg = doc["programRegistry"]
     assert reg["vm_cache"] == {"disk_hits": 1, "disk_misses": 1}
     assert reg["programs"]["hard_part[k=0,fold=32]"]["vm_cache"] == "miss"
@@ -257,13 +298,79 @@ def test_every_registered_span_stage_is_exported():
     assert CHAIN_STAGES == registry.SPAN_STAGES["chain"]
 
 
+def test_stitched_chrome_joins_worker_pids_by_flow_id():
+    """The ISSUE 19 stitching contract: worker spans render on their own
+    pids (WORKER_PID_BASE + index in sorted-label order), every serve
+    stage appears on EVERY worker pid, and each forwarded flow id's
+    worker-side start has a router-side finish — the fleet trace reads
+    as one pipeline across >= 2 processes."""
+    doc = _golden_stitched()
+    pids = doc["otherData"]["workerPids"]
+    assert pids == {"w0": {"pid": WORKER_PID_BASE, "os_pid": 4242},
+                    "w1": {"pid": WORKER_PID_BASE + 1, "os_pid": 4243}}
+    by_pid = {}
+    starts, finishes = {}, set()
+    for ev in doc["traceEvents"]:
+        if ev["ph"] == "X":
+            by_pid.setdefault(ev["pid"], set()).add(ev["name"])
+        elif ev["ph"] == "s":
+            starts[ev["id"]] = ev
+        elif ev["ph"] == "f":
+            finishes.add(ev["id"])
+    worker_pids = [p for p in by_pid if p >= WORKER_PID_BASE]
+    assert len(worker_pids) >= 2
+    for pid in worker_pids:
+        assert set(STAGES) <= by_pid[pid], f"pid {pid} missing stages"
+    # every worker-side flow start joins a router-side finish by id,
+    # start before finish (Perfetto draws the cross-pid arrow)
+    worker_starts = {fid: ev for fid, ev in starts.items()
+                     if ev["pid"] >= WORKER_PID_BASE}
+    assert sorted(worker_starts) == [8, 9]
+    assert set(worker_starts) <= finishes
+    finish_ts = {ev["id"]: ev["ts"] for ev in doc["traceEvents"]
+                 if ev["ph"] == "f"}
+    for fid, ev in worker_starts.items():
+        assert ev["ts"] <= finish_ts[fid]
+    # w0's submit (0.0005s) predates the tracer epoch (0.001s): the
+    # rewind keeps every stitched timestamp non-negative
+    assert all(ev["ts"] >= 0 for ev in doc["traceEvents"]
+               if ev["ph"] in ("X", "s", "f"))
+
+
+def test_trace_wire_roundtrip_and_rid_deltas():
+    """`trace_to_wire` is JSON-safe and `wire_spans` ships rid DELTAS —
+    the snapshot carrier contract the aggregator's watermarks rely on."""
+    t = {"now": 0.0}
+
+    def clock():
+        t["now"] += 0.001
+        return t["now"]
+
+    tr = Tracer(capacity=8, clock=clock)
+    for i in range(3):
+        req = tr.begin("fast_aggregate", 1, t_submit=0.001 * i,
+                       flow=20 + i)
+        tr.span(req, "finalize", 0.001 * i, 0.001 * i + 0.0005)
+        tr.finish(req, True, t_done=0.001 * i + 0.0005)
+    wires = wire_spans(tr)
+    assert [w["rid"] for w in wires] == [1, 2, 3]
+    # JSON round trip preserves everything the stitch consumes
+    back = json.loads(json.dumps(wires[0]))
+    assert back == trace_to_wire(tr.completed()[0])
+    assert back["flow"] == 20 and back["spans"][0][0] == "finalize"
+    # the incremental form: only rids past the watermark ship
+    assert [w["rid"] for w in wire_spans(tr, since_rid=2)] == [3]
+
+
 def test_chrome_export_matches_golden(tmp_path):
     """The export schema is a public contract (Perfetto/chrome://tracing
-    consume it): byte-identical JSON for a fixed synthetic input. On
-    intentional schema changes regenerate with
-    `python tests/test_obs.py --regen-golden`."""
-    tr = _golden_tracer()
-    path = tr.dump(str(tmp_path / "trace.json"))
+    consume it): byte-identical JSON for a fixed synthetic input — the
+    STITCHED document since ISSUE 19, so the golden pins worker pids and
+    cross-process flow joins too. On intentional schema changes
+    regenerate with `python tests/test_obs.py --regen-golden`."""
+    path = str(tmp_path / "trace.json")
+    with open(path, "w") as fh:
+        fh.write(json.dumps(_golden_stitched(), indent=1, sort_keys=True))
     with open(path) as fh:
         got = json.load(fh)
     with open(GOLDEN) as fh:
@@ -635,5 +742,6 @@ def test_bench_serve_trace_flag_writes_chrome_json(tmp_path, monkeypatch,
 if __name__ == "__main__" and "--regen-golden" in sys.argv:
     os.environ["CONSENSUS_SPECS_TPU_TRACE"] = "0"
     obs_programs.reset()
-    _golden_tracer().dump(GOLDEN)
+    with open(GOLDEN, "w") as fh:
+        fh.write(json.dumps(_golden_stitched(), indent=1, sort_keys=True))
     print(f"regenerated {GOLDEN}")

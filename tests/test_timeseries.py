@@ -1,0 +1,332 @@
+"""Time-series store (ISSUE 19): the merge algebra (max-sub wins, ties
+sum, hist deltas add), multi-resolution retention, the wire codec, and
+the acceptance property — a fleet feed split across N stores and merged
+is BIT-EXACT against the same feed into one store, through a real JSON
+round trip. All inputs use dyadic-rational values (multiples of 2^-6),
+so float addition is exact and `==` is the honest comparison.
+"""
+import json
+
+import pytest
+
+from consensus_specs_tpu.obs import hist
+from consensus_specs_tpu.obs.exposition import start_exposition
+from consensus_specs_tpu.obs.timeseries import (
+    TS_WIRE_VERSION,
+    TimeSeriesError,
+    TimeSeriesStore,
+    downsample,
+    merge_level,
+    merge_point,
+    merge_wires,
+    new_point,
+    render_wire,
+)
+from consensus_specs_tpu.ops import profiling
+
+
+@pytest.fixture(autouse=True)
+def _clean_profiling():
+    profiling.reset()
+    yield
+    profiling.reset()
+
+
+def _q(x):
+    """Dyadic rational: exact under float addition."""
+    return x / 64.0
+
+
+def _json_roundtrip(wire):
+    return json.loads(json.dumps(wire, sort_keys=True))
+
+
+def _point(g=None, h=None):
+    p = new_point()
+    for label, (value, sub) in (g or {}).items():
+        p["g"][label] = [value, sub]
+    for label, d in (h or {}).items():
+        p["h"][label] = {"counts": dict(d.get("counts", {})),
+                         "count": d.get("count", 0),
+                         "sum": d.get("sum", 0.0)}
+    return p
+
+
+# -- point algebra ------------------------------------------------------------
+
+
+def test_merge_point_max_sub_wins_and_ties_sum():
+    a = _point(g={"x": (_q(3), 5), "y": (_q(1), 2)})
+    b = _point(g={"x": (_q(9), 5), "y": (_q(7), 1), "z": (_q(2), 0)})
+    out = merge_point(a, b)
+    assert out["g"]["x"] == [_q(12), 5]   # same sub: contributions sum
+    assert out["g"]["y"] == [_q(1), 2]    # newer sub wins outright
+    assert out["g"]["z"] == [_q(2), 0]    # disjoint labels union
+    # commutative on the nose
+    assert merge_point(b, a) == out
+
+
+def test_merge_point_hist_deltas_add():
+    a = _point(h={"lat": {"counts": {3: 2}, "count": 2, "sum": _q(4)}})
+    b = _point(h={"lat": {"counts": {3: 1, 5: 4}, "count": 5,
+                          "sum": _q(6)}})
+    out = merge_point(a, b)
+    assert out["h"]["lat"] == {"counts": {3: 3, 5: 4}, "count": 7,
+                               "sum": _q(10)}
+
+
+def test_merge_point_is_associative():
+    pts = [
+        _point(g={"x": (_q(1), 0)}, h={"l": {"counts": {1: 1},
+                                             "count": 1, "sum": _q(1)}}),
+        _point(g={"x": (_q(2), 0), "y": (_q(8), 3)}),
+        _point(g={"x": (_q(4), 1)}, h={"l": {"counts": {2: 5},
+                                             "count": 5, "sum": _q(2)}}),
+    ]
+    left = merge_point(merge_point(pts[0], pts[1]), pts[2])
+    right = merge_point(pts[0], merge_point(pts[1], pts[2]))
+    assert left == right
+
+
+def _synthetic_level(seed, n_points=23, labels=("a", "b", "c")):
+    """Deterministic {idx: point} map — varied subs, values, hist mass."""
+    level = {}
+    for i in range(n_points):
+        idx = (seed * 7 + i * 3) % 40
+        g = {}
+        for j, label in enumerate(labels):
+            if (i + j + seed) % 2:
+                g[label] = (_q((seed + 1) * (i + 1) * (j + 2)),
+                            idx * 4 + (i + seed) % 4)
+        h = {}
+        if (i + seed) % 3 == 0:
+            h["lat"] = {"counts": {(i % 6): i + 1}, "count": i + 1,
+                        "sum": _q(i)}
+        cur = level.get(idx)
+        p = _point(g=g, h=h)
+        level[idx] = merge_point(cur, p) if cur is not None else p
+    return level
+
+
+def test_downsample_commutes_with_merge():
+    """The load-bearing algebra property: folding two feeds coarser and
+    then merging equals merging and then folding — for every factor the
+    retention rings use. This is WHY the fleet's coarse levels are exact
+    and not an approximation of the workers' fine levels."""
+    a = _synthetic_level(seed=1)
+    b = _synthetic_level(seed=4)
+    for factor in (2, 10, 60):
+        merged_then_down = downsample(merge_level(a, b), factor)
+        down_then_merged = merge_level(downsample(a, factor),
+                                       downsample(b, factor))
+        assert merged_then_down == down_then_merged, f"factor {factor}"
+
+
+# -- store ingestion + retention ----------------------------------------------
+
+
+def _feed(store, t, gauges):
+    store.sample(now=float(t), gauges=gauges, hists={})
+
+
+def test_store_coarse_levels_equal_downsampled_fine_level():
+    store = TimeSeriesStore(interval_s=1.0, capacity=512)
+    for t in range(0, 130):
+        _feed(store, t, {"g.x": _q(t), "g.y": _q(2 * t + 1)})
+    wire = store.to_wire()
+    fine = {int(i): p for i, p in wire["levels"]["1"].items()}
+    for factor in (10, 60):
+        want = downsample({i: _decode(p) for i, p in fine.items()}, factor)
+        got = {int(i): _decode(p)
+               for i, p in wire["levels"][str(factor)].items()}
+        assert got == want, f"level {factor} diverged from its definition"
+
+
+def _decode(wire_point):
+    p = new_point()
+    for label, pair in wire_point["g"].items():
+        p["g"][label] = [float(pair[0]), int(pair[1])]
+    for label, d in wire_point["h"].items():
+        p["h"][label] = {"counts": {int(i): int(n)
+                                    for i, n in d["counts"].items()},
+                         "count": int(d["count"]),
+                         "sum": float(d["sum"])}
+    return p
+
+
+def test_store_eviction_bounds_every_level():
+    store = TimeSeriesStore(interval_s=1.0, capacity=16)
+    for t in range(0, 400):
+        _feed(store, t, {"g.x": _q(t)})
+    wire = store.to_wire()
+    for res, level in wire["levels"].items():
+        assert len(level) <= 16, f"level {res} grew past capacity"
+    # the fine level evicted (400 samples > 16 points) and said so
+    assert store.evicted > 0
+    assert store.samples == 400
+    # retained fine points are the NEWEST (eviction pops the oldest idx)
+    fine_idxs = sorted(int(i) for i in wire["levels"]["1"])
+    assert fine_idxs == list(range(384, 400))
+
+
+def test_store_hist_samples_record_deltas_not_cumulatives():
+    store = TimeSeriesStore(interval_s=1.0, capacity=64)
+    h = hist.Histogram()
+    h.observe(0.001)
+    h.observe(0.002)
+    store.sample(now=0.0, gauges={}, hists={"lat": h})
+    h.observe(0.004)
+    store.sample(now=1.0, gauges={}, hists={"lat": h})
+    wire = store.to_wire()
+    fine = wire["levels"]["1"]
+    assert fine["0"]["h"]["lat"]["count"] == 2   # first sample: full state
+    assert fine["1"]["h"]["lat"]["count"] == 1   # second: the delta only
+    # the 10x point holds the SUM of the window's deltas == cumulative
+    assert wire["levels"]["10"]["0"]["h"]["lat"]["count"] == 3
+
+
+# -- the acceptance property: split feed == single feed -----------------------
+
+
+def _label_split_feeds():
+    """One fleet-shaped feed: per-worker label namespaces (the live
+    fleet's shape — worker gauges arrive prefixed), identical sample
+    clock. Returns (single_store, [worker stores])."""
+    single = TimeSeriesStore(interval_s=1.0, capacity=256)
+    w0 = TimeSeriesStore(interval_s=1.0, capacity=256)
+    w1 = TimeSeriesStore(interval_s=1.0, capacity=256)
+    for t in range(0, 75):
+        g0 = {"serve[w0].queue_depth": _q(t % 13),
+              "serve[w0].submits": _q(3 * t)}
+        g1 = {"serve[w1].queue_depth": _q((t + 5) % 11),
+              "serve[w1].submits": _q(2 * t + 1)}
+        single.sample(now=float(t), gauges={**g0, **g1}, hists={})
+        w0.sample(now=float(t), gauges=g0, hists={})
+        w1.sample(now=float(t), gauges=g1, hists={})
+    return single, [w0, w1]
+
+
+def test_merged_fleet_wire_is_bitexact_vs_single_store_label_split():
+    single, workers = _label_split_feeds()
+    merged = merge_wires([_json_roundtrip(w.to_wire()) for w in workers])
+    assert _json_roundtrip(merged) == _json_roundtrip(single.to_wire())
+
+
+def test_merged_fleet_wire_is_bitexact_vs_single_store_time_split():
+    """Same label, feed split in TIME across two stores (a worker handoff
+    mid-soak): the max-sub rule makes the merged coarse points identical
+    to the uninterrupted store's."""
+    single = TimeSeriesStore(interval_s=1.0, capacity=256)
+    early = TimeSeriesStore(interval_s=1.0, capacity=256)
+    late = TimeSeriesStore(interval_s=1.0, capacity=256)
+    for t in range(0, 64):
+        g = {"health.participation_rate": _q(40 + t % 9)}
+        single.sample(now=float(t), gauges=g, hists={})
+        (early if t < 31 else late).sample(now=float(t), gauges=g,
+                                           hists={})
+    merged = merge_wires([_json_roundtrip(early.to_wire()),
+                          _json_roundtrip(late.to_wire())])
+    assert _json_roundtrip(merged) == _json_roundtrip(single.to_wire())
+
+
+def test_merged_render_is_bitexact_too():
+    """/timeseries serves the RENDERED document — the property must
+    survive rendering, not just the wire."""
+    single, workers = _label_split_feeds()
+    merged = merge_wires([w.to_wire() for w in workers])
+    assert json.dumps(render_wire(merged), sort_keys=True) == \
+        json.dumps(single.render(), sort_keys=True)
+
+
+def test_merge_is_idempotent_on_duplicate_feeds():
+    """Re-ingesting the same worker wire (a double poll) must not double
+    gauge values: same (sub, value) contributions sum — so this is the
+    one algebra caveat — but POINTWISE self-merge keeps eviction and
+    structure sane; the router dedupes by polling latest-per-worker.
+    What we pin here: merging a wire with an EMPTY wire is identity."""
+    single, _ = _label_split_feeds()
+    wire = single.to_wire()
+    empty = TimeSeriesStore(interval_s=1.0, capacity=4).to_wire()
+    assert _json_roundtrip(merge_wires([wire, empty])) == \
+        _json_roundtrip(wire)
+
+
+# -- wire hygiene -------------------------------------------------------------
+
+
+def test_merge_rejects_wire_version_mismatch():
+    good = TimeSeriesStore(interval_s=1.0).to_wire()
+    bad = dict(good, v=TS_WIRE_VERSION + 1)
+    with pytest.raises(TimeSeriesError):
+        merge_wires([good, bad])
+    with pytest.raises(TimeSeriesError):
+        render_wire({"levels": {}})  # missing version entirely
+
+
+def test_merge_rejects_interval_mismatch():
+    a = TimeSeriesStore(interval_s=1.0)
+    b = TimeSeriesStore(interval_s=6.0)
+    _feed(a, 0, {"x": 1.0})
+    _feed(b, 0, {"x": 1.0})
+    with pytest.raises(TimeSeriesError):
+        merge_wires([a.to_wire(), b.to_wire()])
+
+
+def test_merge_rejects_malformed_points():
+    good = TimeSeriesStore(interval_s=1.0)
+    _feed(good, 0, {"x": 1.0})
+    wire = _json_roundtrip(good.to_wire())
+    wire["levels"]["1"]["0"]["g"]["x"] = ["not-a-number", None]
+    with pytest.raises(TimeSeriesError):
+        merge_wires([wire])
+
+
+# -- rendering + artifacts ----------------------------------------------------
+
+
+def test_render_wire_shape_and_percentiles():
+    store = TimeSeriesStore(interval_s=2.0, capacity=64)
+    h = hist.Histogram()
+    for _ in range(100):
+        h.observe(0.010)
+    store.sample(now=0.0, gauges={"g.x": _q(1)}, hists={"lat": h})
+    doc = store.render()
+    assert doc["v"] == TS_WIRE_VERSION and doc["interval_s"] == 2.0
+    by_res = {lv["resolution_s"]: lv for lv in doc["levels"]}
+    assert set(by_res) == {2.0, 20.0, 120.0}
+    point = by_res[2.0]["points"][0]
+    assert point["t"] == 0.0
+    assert point["gauges"]["g.x"] == _q(1)
+    lat = point["hists"]["lat"]
+    assert lat["count"] == 100
+    # log-bucketed percentiles: within one bucket width of the truth
+    assert 8.0 <= lat["p50_ms"] <= 12.0
+    assert 8.0 <= lat["p99_ms"] <= 12.0
+
+
+def test_dump_jsonl_is_one_header_plus_one_line_per_point(tmp_path):
+    store = TimeSeriesStore(interval_s=1.0, capacity=64)
+    for t in range(0, 12):
+        _feed(store, t, {"g.x": _q(t)})
+    path = store.dump_jsonl(str(tmp_path / "ts.jsonl"))
+    lines = [json.loads(l) for l in open(path) if l.strip()]
+    header, rows = lines[0], lines[1:]
+    assert header["timeseries"] == f"v{TS_WIRE_VERSION}"
+    assert header["points"] == len(rows)
+    assert header["levels"] == [1.0, 10.0, 60.0]
+    # 12 fine points + 2 at 10x + 1 at 60x
+    assert len(rows) == 12 + 2 + 1
+    for row in rows:
+        assert set(row) >= {"idx", "t", "gauges", "hists", "resolution_s"}
+
+
+def test_timeseries_endpoint_serves_merged_document():
+    single, workers = _label_split_feeds()
+    merged = merge_wires([w.to_wire() for w in workers])
+    with start_exposition(
+            port=0, timeseries_fn=lambda: render_wire(merged)) as server:
+        import urllib.request
+
+        with urllib.request.urlopen(server.url("/timeseries")) as resp:
+            doc = json.loads(resp.read())
+    assert doc == json.loads(json.dumps(single.render()))

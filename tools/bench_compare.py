@@ -93,10 +93,20 @@ wrong state roots is a consensus-correctness regression, not a perf
 number); the cold/incremental/proof-world speedups and roots/sec are
 report-only.
 
+Health gating: rounds that carry a ``health`` section (`bench.py --mode
+soak` — the long-horizon consensus health ledger) gate on the same
+state rule: a soak whose gate (participation floor, bounded finality
+lag, zero unexplained reorgs) held in the previous round and reports
+diverged in the newest fails the round outright ("HEALTH DIVERGED" —
+slow-burn consensus sickness is a correctness regression, not perf
+jitter); participation movement within a green gate is report-only.
+
 Output: the comparison table is also emitted as GitHub-flavored markdown
 — appended to ``$GITHUB_STEP_SUMMARY`` when CI sets it, printed to stdout
 otherwise — so the round-over-round numbers land on the workflow summary
-page without artifact digging.
+page without artifact digging. The markdown additionally carries a
+headline-trajectory section tracing each (platform, shape) headline
+across EVERY recorded round, not just the newest pair.
 """
 import argparse
 import glob
@@ -416,15 +426,95 @@ def extract_finalexp(doc):
     return out
 
 
+def extract_health(doc):
+    """{``platform:health:<scope>``: {"ok", "participation_min",
+    "unexplained_reorgs"}} from one round's ``health`` section
+    (`bench.py --mode soak` — the consensus health ledger's gate verdict
+    over the whole horizon, aggregate plus per node)."""
+    parsed = doc.get("parsed")
+    if not isinstance(parsed, dict) or "error" in parsed:
+        return {}
+    section = parsed.get("health")
+    if not isinstance(section, dict):
+        return {}
+    gate = section.get("gate")
+    if not isinstance(gate, dict):
+        return {}
+    plat = _platform(parsed)
+    out = {}
+
+    def row(scope, ok, summary):
+        if not isinstance(summary, dict):
+            return
+        try:
+            pmin = float(summary.get("participation_min", 0.0))
+            reorgs = int(summary.get("unexplained_reorgs", 0))
+        except (TypeError, ValueError):
+            return
+        out[f"{plat}:health:{scope}"] = {
+            "ok": bool(ok),
+            "participation_min": pmin,
+            "unexplained_reorgs": reorgs,
+        }
+
+    row("aggregate", gate.get("ok", False), section.get("aggregate"))
+    per_node = section.get("per_node")
+    if isinstance(per_node, dict):
+        agg_ok = bool(gate.get("ok", False))
+        for name, summary in sorted(per_node.items()):
+            # per-node rows inherit the aggregate verdict (the gate
+            # judges the worst case; a node's own numbers are the trend
+            # detail) — their participation/reorg numbers still land in
+            # the table for the trajectory read
+            row(name, agg_ok, summary)
+    return out
+
+
+def headline_trajectory(files):
+    """One line tracing the headline metric across EVERY recorded round
+    (not just newest vs previous): ``r01 12.3 → r02 14.1 → …`` per
+    (platform, shape) key that appears in two or more rounds. The pair
+    diff answers "did this round regress"; this answers "where has this
+    number been heading" — the soak's whole reason to exist, applied to
+    the bench ledger itself."""
+    series = {}
+    order = []
+    for path in files:
+        m = _ROUND_RE.search(os.path.basename(path))
+        label = f"r{m.group(1)}" if m else os.path.basename(path)
+        try:
+            vals = extract(_load(path))
+        except (OSError, ValueError):
+            continue
+        for key, value in vals.items():
+            series.setdefault(key, []).append((label, value))
+        order.append(label)
+    lines = []
+    for key in sorted(series):
+        points = series[key]
+        if len(points) < 2:
+            continue
+        path_s = " → ".join(f"{label} {value:.4g}"
+                            for label, value in points)
+        first, last = points[0][1], points[-1][1]
+        total = (last - first) / first if first else 0.0
+        lines.append(f"`{key}`: {path_s} ({total:+.1%} over "
+                     f"{len(points)} rounds)")
+    return lines
+
+
 def _load(path):
     with open(path) as fh:
         return json.load(fh)
 
 
-def _emit_markdown(rows, prev_name, new_name, threshold_pct):
+def _emit_markdown(rows, prev_name, new_name, threshold_pct,
+                   trajectory=()):
     """The comparison as a GitHub-flavored markdown table: appended to
     ``$GITHUB_STEP_SUMMARY`` when CI provides one, stdout otherwise.
-    ``rows`` are (key, old, new, delta_frac|None, status) tuples."""
+    ``rows`` are (key, old, new, delta_frac|None, status) tuples;
+    ``trajectory`` are preformatted headline-trajectory lines spanning
+    every recorded round (``headline_trajectory``)."""
     lines = [
         f"### bench-compare: `{prev_name}` → `{new_name}` "
         f"(allowed regression {threshold_pct:.0f}%)",
@@ -436,6 +526,9 @@ def _emit_markdown(rows, prev_name, new_name, threshold_pct):
         delta_s = "—" if delta is None else f"{delta:+.1%}"
         lines.append(
             f"| `{key}` | {old} | {new} | {delta_s} | {status} |")
+    if trajectory:
+        lines += ["", "**Headline trajectory (all rounds):**", ""]
+        lines += [f"- {t}" for t in trajectory]
     body = "\n".join(lines) + "\n"
     summary_path = os.environ.get("GITHUB_STEP_SUMMARY")
     if summary_path:
@@ -477,6 +570,7 @@ def main(argv=None) -> int:
         new_lat = extract_latency(newest_doc)
         new_proofs = extract_proofs(newest_doc)
         new_merkle = extract_merkle(newest_doc)
+        new_health = extract_health(newest_doc)
     except (OSError, ValueError) as e:
         print(f"bench-compare: FAIL — {os.path.basename(newest)} unreadable: {e}")
         return 1
@@ -492,7 +586,7 @@ def main(argv=None) -> int:
 
     prev_vals, prev_slo, prev_sim, prev_mesh = {}, {}, {}, {}
     prev_fx, prev_vx, prev_fleet, prev_lat = {}, {}, {}, {}
-    prev_proofs, prev_merkle, prev_path = {}, {}, None
+    prev_proofs, prev_merkle, prev_health, prev_path = {}, {}, {}, None
     for path in reversed(files[:-1]):
         try:
             doc = _load(path)
@@ -506,22 +600,23 @@ def main(argv=None) -> int:
             prev_lat = extract_latency(doc)
             prev_proofs = extract_proofs(doc)
             prev_merkle = extract_merkle(doc)
+            prev_health = extract_health(doc)
         except (OSError, ValueError):
             prev_vals, prev_slo, prev_sim = {}, {}, {}
             prev_mesh, prev_fx, prev_vx = {}, {}, {}
             prev_fleet, prev_lat, prev_proofs = {}, {}, {}
-            prev_merkle = {}
+            prev_merkle, prev_health = {}, {}
         # an SLO-only or sim-only round (headline errored, objectives or
         # scenario matrix still recorded) is a usable baseline for its
         # state gate even with no throughput number
         if (prev_vals or prev_slo or prev_sim or prev_mesh or prev_fx
                 or prev_vx or prev_fleet or prev_lat or prev_proofs
-                or prev_merkle):
+                or prev_merkle or prev_health):
             prev_path = path
             break
     if not (prev_vals or prev_slo or prev_sim or prev_mesh or prev_fx
             or prev_vx or prev_fleet or prev_lat or prev_proofs
-            or prev_merkle):
+            or prev_merkle or prev_health):
         print("bench-compare: SKIP — no earlier round recorded a usable value")
         return 0
 
@@ -535,10 +630,11 @@ def main(argv=None) -> int:
     lat_common = sorted(set(new_lat) & set(prev_lat))
     proofs_common = sorted(set(new_proofs) & set(prev_proofs))
     merkle_common = sorted(set(new_merkle) & set(prev_merkle))
+    health_common = sorted(set(new_health) & set(prev_health))
     if (not common and not slo_common and not sim_common
             and not mesh_common and not fx_common and not vx_common
             and not fleet_common and not lat_common and not proofs_common
-            and not merkle_common):
+            and not merkle_common and not health_common):
         # SLO keys count as comparables too: two rounds that share no
         # throughput shape but both declare serve_p99 must still gate the
         # objective state, not skip past it
@@ -782,8 +878,36 @@ def main(argv=None) -> int:
         if broke:
             failures.append(key)
 
+    # consensus-health state gate (`bench.py --mode soak`): a soak whose
+    # gate held in the previous round and reports DIVERGED now fails
+    # outright — "HEALTH DIVERGED" (participation under the floor, a
+    # finality-lag bound crossed, or reorgs outside declared disruption
+    # windows are all slow-burn correctness regressions, not perf
+    # jitter); participation movement within a green gate is report-only
+    for key in health_common:
+        old, new = prev_health[key], new_health[key]
+        broke = old["ok"] and not new["ok"]
+        status = "HEALTH DIVERGED" if broke else (
+            "ok" if new["ok"] else "still diverged")
+        print(
+            f"  {key}: participation_min {old['participation_min']:.4f} -> "
+            f"{new['participation_min']:.4f} (unexplained reorgs "
+            f"{old['unexplained_reorgs']} -> {new['unexplained_reorgs']}; "
+            f"ok: {old['ok']} -> {new['ok']})"
+            f"{'  ' + status if broke else ''}"
+        )
+        rows.append((key, f"{old['participation_min']:.4f}",
+                     f"{new['participation_min']:.4f}",
+                     (new["participation_min"] - old["participation_min"])
+                     / old["participation_min"]
+                     if old["participation_min"] else None,
+                     status))
+        if broke:
+            failures.append(key)
+
     _emit_markdown(rows, os.path.basename(prev_path),
-                   os.path.basename(newest), args.max_regression)
+                   os.path.basename(newest), args.max_regression,
+                   trajectory=headline_trajectory(files))
     if failures:
         print(
             f"bench-compare: FAIL — regressed past the gate on: "
@@ -810,6 +934,8 @@ def main(argv=None) -> int:
            if proofs_common else "")
         + (f", {len(merkle_common)} merkle cell(s) gated"
            if merkle_common else "")
+        + (f", {len(health_common)} health scope(s) gated"
+           if health_common else "")
     )
     return 0
 
