@@ -22,7 +22,8 @@ CHEAP_GENERATORS = shuffling bls ssz_generic merkle
         sim-bench sim-smoke serve-bench-mesh mesh-smoke clean rlc-bench \
         finalexp-bench finalexp-smoke native sweep serve-fleet-bench fleet-smoke \
         latency-bench latency-smoke vmexec-bench vmexec-smoke vmexec-cold-smoke \
-        proof-bench proof-smoke merkle-bench merkle-smoke soak-bench soak-smoke
+        proof-bench proof-smoke merkle-bench merkle-smoke soak-bench soak-smoke \
+        mainnet-bench mainnet-smoke
 
 # fast default: BLS stubbed except @always_bls, 4-way process-parallel
 # (reference `make test` = pytest -n 4, reference Makefile:100)
@@ -288,6 +289,32 @@ soak-smoke:
 	JAX_PLATFORMS=cpu CONSENSUS_SPECS_TPU_SOAK_DIR=soak_artifacts python -m consensus_specs_tpu.sim.soak_smoke
 	python tools/render_timeline.py soak_artifacts/soak_timeseries.jsonl -o soak_artifacts/soak_timeline.html
 
+# mainnet-scale workload replay (ISSUE 20): full mainnet-shape slots over
+# the synthetic MILLION-validator registry (scale/) — mainnet-preset
+# 64-committee shuffling computed columnar, real index-derived pubkeys,
+# per-committee aggregate signatures, the hierarchical aggregate-of-
+# aggregates fold (whole slot -> ONE RLC combine -> ONE final exp,
+# final_exps_per_slot == 1.0), the byte-budgeted decompressed-pubkey
+# plane, a planted bad committee localized by bisection, the strict
+# censored_aggregates sim at true 64-committee fan-out, and 2-worker
+# committee-affinity fleet routing. The JSON line's `mainnet` section is
+# state-gated round over round by tools/bench_compare.py ("MAINNET
+# DIVERGED"); attestations/sec, pubkey hit rate, and peak RSS are
+# report-only numbers. CONSENSUS_SPECS_TPU_SCALE_* env resizes.
+mainnet-bench:
+	JAX_PLATFORMS=cpu python bench.py --mode mainnet
+
+# mainnet-workload CI canary (fleet-smoke's scale sibling): an
+# 8192-validator registry (two full-size committees/slot) through the
+# valid / censored / planted-bad-committee rounds with hierarchical ==
+# flat == host-oracle verdict identity, one-final-exp accounting, the
+# pubkey plane under budget, and committee affinity stable across a
+# real 2-worker verdict fleet; journal dumps to scale_flight.jsonl (CI
+# artifact on failure). Crypto-light: summed-sk aggregates over small
+# secret keys keep it CI-fast.
+mainnet-smoke:
+	JAX_PLATFORMS=cpu python -m consensus_specs_tpu.scale.smoke
+
 # end-to-end gossip→head latency matrix (ISSUE 12): latency_skew and
 # lossy_links simnet scenarios, each run under the classic
 # size-or-deadline flush, the slot-budget deadline scheduler
@@ -400,6 +427,7 @@ clean:
 		vmexec_flight.jsonl vmexec_flight.*.jsonl \
 		proof_flight.jsonl proof_flight.*.jsonl \
 		merkle_flight.jsonl merkle_flight.*.jsonl \
+		scale_flight.jsonl scale_flight.*.jsonl \
 		*-pid[0-9]*.jsonl
 	find . -name __pycache__ -type d -prune -exec rm -rf {} +
 

@@ -42,9 +42,16 @@ def run_merkle_bench() -> dict:
     latency.reset()
     _levels.reset_counters()
 
+    from ..scale.registry import attesters_per_slot
+
     n_validators = int(os.environ.get(VALIDATORS_ENV, "16384"))
     n_blocks = max(1, int(os.environ.get(BLOCKS_ENV, "16")))
-    n_touch = max(1, int(os.environ.get(TOUCH_ENV, "64")))
+    # the per-block state delta defaults to the registry's REAL per-slot
+    # attestation fan-out (n/SLOTS_PER_EPOCH — every committee of the
+    # slot, the same shape the mainnet replay drives), not a made-up
+    # constant; TOUCH_ENV still overrides for sweeps
+    n_touch = max(1, int(os.environ.get(
+        TOUCH_ENV, str(attesters_per_slot(n_validators)))))
 
     spec = build_spec_module("altair", "minimal")
     world = ProofWorld(spec, validators=n_validators)

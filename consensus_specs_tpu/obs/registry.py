@@ -226,6 +226,33 @@ GAUGES: Dict[str, str] = {
                      "process (resource.getrusage)",
     "process.open_fds": "open file descriptors held by this process "
                         "(/proc/self/fd count; -1 when unreadable)",
+    "scale.registry_validators": "validators registered in the "
+                                 "synthetic mainnet registry (columnar; "
+                                 "never materialized per-validator)",
+    "scale.pubkey_cache_hits": "pubkey-plane lookups served from the "
+                               "bytes-budgeted LRU of decompressed G1 "
+                               "keys",
+    "scale.pubkey_cache_misses": "pubkey-plane lookups that paid "
+                                 "batched G1 decompression through the "
+                                 "vectorized codec path",
+    "scale.pubkey_cache_bytes": "decompressed-key bytes currently "
+                                "resident in the pubkey plane (held "
+                                "under CONSENSUS_SPECS_TPU_SCALE_"
+                                "PK_BUDGET_MB)",
+    "scale.pubkey_cache_evictions": "LRU entries evicted (and "
+                                    "un-mirrored from the backend host "
+                                    "cache) to stay under the byte "
+                                    "budget",
+    "scale.pubkey_hit_rate": "pubkey-plane hits / (hits + misses) over "
+                             "the process lifetime",
+    "scale.final_exps_per_slot": "final exponentiations the last "
+                                 "hierarchical slot fold paid (1 = the "
+                                 "whole slot shared one RLC root)",
+    "scale.committees_routed": "distinct committees the affinity "
+                               "router has assigned to fleet workers",
+    "scale.affinity_moves": "committees whose affine worker changed "
+                            "(ring churn from drains/respawns; 0 on a "
+                            "stable fleet)",
 }
 
 STATS: Dict[str, str] = {

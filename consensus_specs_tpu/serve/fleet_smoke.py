@@ -10,7 +10,9 @@ processes, real bls backend):
    must answer bit-identically to (a) a single-process
    ``VerificationService`` over the same backend and (b) the pure-Python
    host oracle. The merged ``/metrics`` scrape must be the exact merge
-   of the per-worker snapshots.
+   of the per-worker snapshots. Every worker snapshot must additionally
+   report ``extra["warm_bg"]`` true — background VM warming
+   (``CONSENSUS_SPECS_TPU_VM_WARM_BG``) is the fleet-worker default.
 
 2. **Forced worker fault -> SLO-burn-driven decision**: one worker's
    backend is armed to fail, distinct committees routed to THAT worker
@@ -128,6 +130,19 @@ def main() -> int:
         assert got_fleet == got_single == oracle == want, (
             f"verdict identity violated: fleet={got_fleet} "
             f"single={got_single} oracle={oracle} want={want}")
+
+        # -- background-warm default (ISSUE 20 satellite) ---------------------
+        # every worker must report warm_bg armed in its snapshot extra:
+        # the fleet's fresh processes background-compile cold shapes off
+        # the serving path by default (worker main() setdefaults
+        # CONSENSUS_SPECS_TPU_VM_WARM_BG=1; a regression here silently
+        # returns the fleet to interpreter-only cold starts)
+        snaps = router.poll_snapshots()
+        warm_flags = {label: snap.get("extra", {}).get("warm_bg")
+                      for label, snap in snaps.items()}
+        assert len(warm_flags) == WORKERS and all(warm_flags.values()), (
+            f"background VM warming not armed on every worker: "
+            f"{warm_flags}")
 
         # baseline: merge the identity-phase state and checkpoint the
         # burn windows — only fault-phase mass can burn from here

@@ -37,6 +37,10 @@ by the simnet fleet replay and the tier-1 tests — no BLS math, device
 work, or XLA compiles; the package import still pays the jax import,
 which ops/__init__ does eagerly);
 ``SERVE_MAX_BATCH`` / ``SERVE_MAX_WAIT_MS`` size the service's flush.
+``CONSENSUS_SPECS_TPU_VM_WARM_BG`` defaults to ``1`` in workers (set
+explicitly to ``0`` to disarm): cold shapes background-compile off the
+serving path and flip to fused when ready; each snapshot reports the
+effective state as ``extra["warm_bg"]`` (the fleet smoke gates on it).
 
 The ``fault`` op arms deterministic backend-fault injection (the
 in-process `FailingBackendProxy`'s cross-process sibling): the next
@@ -204,7 +208,14 @@ def _decode_submit(msg):
 def main() -> int:
     _apply_affinity()
     label = os.environ.get(WORKER_ENV, f"w{os.getpid()}")
+    # background VM warming is the fleet default (ISSUE 20 satellite): a
+    # fresh worker's auto-routed executions enqueue daemon-thread
+    # compiles and flip to fused when they land, instead of staying
+    # interpreter-only until someone pays a compile on the serving path.
+    # setdefault so an explicit router/operator "0" still disarms it.
+    os.environ.setdefault("CONSENSUS_SPECS_TPU_VM_WARM_BG", "1")
     from ..obs import snapshot, timeseries
+    from ..ops import vm_compile
     from ..utils import bls
 
     # verdicts must flow through the service, not the stub's eager True
@@ -260,7 +271,8 @@ def main() -> int:
                         worker=label,
                         extra={"serve": svc.metrics.snapshot(),
                                "ladder_rung": svc.ladder_rung,
-                               "faults_fired": backend.fired},
+                               "faults_fired": backend.fired,
+                               "warm_bg": vm_compile._bg_warm_enabled()},
                         flight_since=int(msg.get("flight_since", 0)),
                         spans_since=int(msg.get("spans_since", 0)))
                     send({"op": "snapshot", "id": req_id, "data": data})

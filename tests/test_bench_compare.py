@@ -1009,6 +1009,108 @@ def test_merkle_extract_shapes(bc):
     assert bc.extract_merkle({"parsed": _parsed(300.0)}) == {}
 
 
+# -- mainnet-scale workload state gate (ISSUE 20) ----------------------------
+
+
+def _mainnet_parsed(value, sections, **extra):
+    """A `--mode mainnet` round: sections maps section name ->
+    (ok, atts_per_sec)."""
+    section = {
+        name: {"ok": ok, "atts_per_sec": aps, "validators": 1 << 20}
+        for name, (ok, aps) in sections.items()
+    }
+    return _parsed(value, mode="mainnet", n=None, k=None,
+                   mainnet=section, **extra)
+
+
+def test_mainnet_newly_diverged_section_fails(tmp_path, bc, capsys):
+    """The mainnet gate: a replay section whose correctness claim held
+    last round (hierarchical verdicts identical to the flat path) and
+    breaks in the newest fails outright — "MAINNET DIVERGED", the
+    merkle-gate mirror for the million-validator workload plane."""
+    _write_round(tmp_path, 1, _mainnet_parsed(
+        300.0, {"slot_replay": (True, 450.0)}))
+    _write_round(tmp_path, 2, _mainnet_parsed(
+        300.0, {"slot_replay": (False, 460.0)}))
+    assert bc.main(["--dir", str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "cpu:mainnet:slot_replay" in out and "MAINNET DIVERGED" in out
+
+
+def test_mainnet_atts_per_sec_movement_is_report_only(tmp_path, bc,
+                                                      capsys):
+    """Attestations/sec halving never fails the mainnet gate on its own
+    — CPU replay throughput jitters; the page-worthy event is verdict
+    identity (or the strict sim gate) breaking."""
+    _write_round(tmp_path, 1, _mainnet_parsed(
+        300.0, {"slot_replay": (True, 450.0),
+                "censored_sim": (True, 0.0)}))
+    _write_round(tmp_path, 2, _mainnet_parsed(
+        290.0, {"slot_replay": (True, 210.0),
+                "censored_sim": (True, 0.0)}))
+    assert bc.main(["--dir", str(tmp_path)]) == 0
+    assert "cpu:mainnet:slot_replay" in capsys.readouterr().out
+
+
+def test_mainnet_still_diverged_is_not_a_new_failure(tmp_path, bc):
+    """ok False -> False: the flip round already failed once; a
+    permanently-red section must not wedge every future round."""
+    _write_round(tmp_path, 1, _mainnet_parsed(
+        300.0, {"bad_committee": (False, 0.0)}))
+    _write_round(tmp_path, 2, _mainnet_parsed(
+        300.0, {"bad_committee": (False, 0.0)}))
+    assert bc.main(["--dir", str(tmp_path)]) == 0
+
+
+def test_mainnet_keys_join_without_common_throughput_keys(tmp_path, bc,
+                                                          capsys):
+    """Shared mainnet keys are comparables in their own right (the
+    SLO/sim/merkle rule): disjoint throughput shapes must still gate an
+    ok -> broken transition instead of skipping."""
+    _write_round(tmp_path, 1, _parsed(
+        1000.0, mode="head", n=None, k=None, blocks=1024,
+        mainnet={"censored_sim": {"ok": True, "atts_per_sec": 0.0}}))
+    _write_round(tmp_path, 2, _parsed(
+        900.0, mode="head", n=None, k=None, blocks=128,
+        mainnet={"censored_sim": {"ok": False, "atts_per_sec": 0.0}}))
+    assert bc.main(["--dir", str(tmp_path)]) == 1
+    assert "MAINNET DIVERGED" in capsys.readouterr().out
+
+
+def test_mainnet_only_previous_round_is_a_usable_baseline(tmp_path, bc,
+                                                          capsys):
+    """A prior round whose headline value is unusable but whose mainnet
+    section recorded verdict state still baselines the mainnet gate —
+    the walk must not skip past it to 'no earlier round'."""
+    broken = _mainnet_parsed(300.0, {"affinity": (True, 0.0)})
+    broken["value"] = 0.0  # headline unusable, mainnet section intact
+    _write_round(tmp_path, 1, broken)
+    _write_round(tmp_path, 2, _mainnet_parsed(
+        300.0, {"affinity": (False, 0.0)}))
+    assert bc.main(["--dir", str(tmp_path)]) == 1
+    assert "MAINNET DIVERGED" in capsys.readouterr().out
+
+
+def test_mainnet_new_sections_are_not_gated_until_seen(tmp_path, bc):
+    """A section appearing for the first time has no baseline —
+    report-only this round, gated from the next."""
+    _write_round(tmp_path, 1, _mainnet_parsed(
+        300.0, {"slot_replay": (True, 450.0)}))
+    _write_round(tmp_path, 2, _mainnet_parsed(
+        300.0, {"slot_replay": (True, 450.0),
+                "bad_committee": (False, 0.0)}))
+    assert bc.main(["--dir", str(tmp_path)]) == 0
+
+
+def test_mainnet_extract_shapes(bc):
+    doc = {"parsed": _mainnet_parsed(
+        300.0, {"slot_replay": (True, 444.1)})}
+    assert bc.extract_mainnet(doc) == {
+        "cpu:mainnet:slot_replay": {"ok": True, "atts_per_sec": 444.1}}
+    assert bc.extract_mainnet({"parsed": {"error": "boom"}}) == {}
+    assert bc.extract_mainnet({"parsed": _parsed(300.0)}) == {}
+
+
 # -- consensus-health state gate (ISSUE 19) ----------------------------------
 
 

@@ -82,7 +82,8 @@ def test_emitted_labels_were_actually_found():
                      "vm.analysis_programs", "vm.analysis_errors",
                      "vm.analysis_hazards", "vm.analysis_max_live",
                      "hist.families", "device.count", "flight.events",
-                     "slo.ok", "bls.vm_cache_pruned_bytes"):
+                     "slo.ok", "bls.vm_cache_pruned_bytes",
+                     "scale.final_exps_per_slot", "scale.pubkey_hit_rate"):
         assert expected in found, f"label scan lost {expected}"
 
 
@@ -120,6 +121,24 @@ def test_merkle_gauge_family_is_complete():
     )
     assert {f"merkle.{k}" for k in merkle_levels.counters} == registered, (
         "merkle counters dict and registered merkle.* gauges diverged"
+    )
+
+
+def test_scale_gauge_family_is_complete():
+    # the mainnet workload plane (ISSUE 20): every scale.* gauge the
+    # registry / pubkey plane / hierarchy fold / fleet routing emit must
+    # be registered and every registered scale.* gauge must have an
+    # emission site — the million-validator replay's numbers (pubkey hit
+    # rate, final exps per slot, affinity moves) can never silently
+    # orphan the README table or a scrape rule
+    emitted = {label for label in _emitted_labels()
+               if label.startswith("scale.")}
+    registered = {n for n in registry.GAUGES if n.startswith("scale.")}
+    assert registered, "the scale.* gauge family vanished from the registry"
+    assert emitted == registered, (
+        f"scale gauge drift: emitted-not-registered="
+        f"{emitted - registered}, registered-not-emitted="
+        f"{registered - emitted}"
     )
 
 

@@ -1,0 +1,202 @@
+"""Tier-1 coverage for the mainnet-scale workload plane (ISSUE 20):
+registry determinism + spec-shuffle equivalence, lazy iteration memory
+bounds, committee-affinity routing, and the hierarchical verify
+path's accounting. Crypto is kept to a handful of tiny keys so the
+whole module stays inside the tier-1 budget; the pubkey-plane LRU
+has its own module, test_scale_pubkeys.py."""
+import hashlib
+import tracemalloc
+
+import pytest
+
+from consensus_specs_tpu.scale import hierarchy, pubkeys, registry, routing
+from consensus_specs_tpu.scale.registry import Registry, shuffle_batch
+
+
+# ---------------------------------------------------------------------------
+# registry: determinism + spec equivalence
+# ---------------------------------------------------------------------------
+
+
+def test_registry_digest_is_seed_deterministic():
+    a = Registry(24, seed=7).digest()
+    b = Registry(24, seed=7).digest()
+    c = Registry(24, seed=8).digest()
+    assert a == b
+    assert a != c
+    # sampled digests are deterministic too (the 1M bench's form)
+    assert (Registry(24, seed=7).digest(sample=5)
+            == Registry(24, seed=7).digest(sample=5))
+
+
+def test_registry_secret_keys_distinct_and_small():
+    reg = Registry(1 << 20, seed=3)
+    sks = {reg.secret_key(i) for i in (0, 1, 5, (1 << 20) - 1)}
+    assert len(sks) == 4
+    assert all(0 < sk < (1 << 40) for sk in sks)
+    with pytest.raises(IndexError):
+        reg.secret_key(1 << 20)
+
+
+def test_shuffle_batch_matches_spec_minimal_and_mainnet():
+    from consensus_specs_tpu.builder import build_spec_module
+
+    seed = hashlib.sha256(b"scale-shuffle-equivalence").digest()
+    for preset, n in (("minimal", 97), ("mainnet", 65)):
+        spec = build_spec_module("phase0", preset)
+        rounds = int(spec.SHUFFLE_ROUND_COUNT)
+        mine = shuffle_batch(n, seed, rounds)
+        ref = [int(spec.compute_shuffled_index(
+            spec.uint64(i), spec.uint64(n), seed)) for i in range(n)]
+        assert mine.tolist() == ref
+
+
+def test_registry_committees_match_spec_compute_committee():
+    from consensus_specs_tpu.builder import build_spec_module
+
+    spec = build_spec_module("phase0", "mainnet")
+    # pin the registry's baked-in mainnet constants against specsrc
+    assert registry.SLOTS_PER_EPOCH == int(spec.SLOTS_PER_EPOCH)
+    assert registry.MAX_COMMITTEES_PER_SLOT == int(
+        spec.MAX_COMMITTEES_PER_SLOT)
+    assert registry.TARGET_COMMITTEE_SIZE == int(spec.TARGET_COMMITTEE_SIZE)
+    assert registry.SHUFFLE_ROUND_COUNT == int(spec.SHUFFLE_ROUND_COUNT)
+
+    n, slot = 131, 5
+    reg = Registry(n, seed=11)
+    per_slot = reg.committees_per_slot()
+    assert per_slot == 1  # below the target size floor
+    seed = reg.attester_seed(slot // registry.SLOTS_PER_EPOCH)
+    count = per_slot * registry.SLOTS_PER_EPOCH
+    flat = (slot % registry.SLOTS_PER_EPOCH) * per_slot
+    indices = [spec.uint64(i) for i in range(n)]
+    ref = [int(v) for v in spec.compute_committee(
+        indices, seed, spec.uint64(flat), spec.uint64(count))]
+    assert reg.committee(slot, 0).tolist() == ref
+
+
+def test_committee_fanout_covers_registry_once_per_epoch():
+    reg = Registry(4096, seed=2, shuffle_rounds=4)
+    seen = []
+    for slot in range(registry.SLOTS_PER_EPOCH):
+        for com in reg.committees_at_slot(slot):
+            seen.extend(int(v) for v in com)
+    assert sorted(seen) == list(range(4096))
+    assert registry.attesters_per_slot(4096) == 128
+    assert registry.committee_count_per_slot(1 << 20) == 64
+
+
+def test_registry_lazy_iteration_is_memory_bounded():
+    # a million-validator registry + one epoch permutation must stay
+    # columnar: the uint64 column is 8 MB; the budget leaves headroom
+    # for numpy temporaries but is far below any per-validator
+    # materialization (1M Python ints alone would be ~28 MB+)
+    tracemalloc.start()
+    try:
+        reg = Registry(1 << 20, seed=5, shuffle_rounds=2)
+        com = reg.committee(0, 0)
+        assert len(com) == (1 << 20) // (32 * 64)
+        # streaming the index column in batches must not accumulate
+        count = 0
+        for idx, _pks in Registry(256, seed=5).iter_pubkeys(batch=64):
+            count += len(idx)
+        assert count == 256
+        _, peak = tracemalloc.get_traced_memory()
+    finally:
+        tracemalloc.stop()
+    assert peak < 64 * (1 << 20), f"peak {peak} bytes: not columnar"
+
+
+# ---------------------------------------------------------------------------
+# routing: committee affinity on the consistent-hash ring
+# ---------------------------------------------------------------------------
+
+
+class _FakeRouter:
+    def __init__(self, labels):
+        from consensus_specs_tpu.serve.fleet import HashRing
+        import threading
+
+        self._ring = HashRing()
+        for lb in labels:
+            self._ring.add(lb)
+        self._lock = threading.Lock()
+        self.requests = 0
+        self.submitted = []
+
+    def route_label(self, key):
+        return self._ring.route(key)
+
+    def handle(self, label):
+        router = self
+
+        class _H:
+            def submit(self, kind, pks, msgs, sig, birth_s=None,
+                       flow_id=None):
+                from concurrent.futures import Future
+
+                router.submitted.append(label)
+                fut = Future()
+                fut.set_result(True)
+                return fut
+
+        return _H()
+
+
+def test_committee_affinity_is_stable_and_counts_moves():
+    fake = _FakeRouter(["w0", "w1", "w2"])
+    fleet = routing.CommitteeFleet(router=fake)
+    first = fleet.assignment(range(32))
+    # stable: resubmitting every committee lands the same worker
+    for ci in range(32):
+        fleet.submit_committee(ci, "fast_aggregate", [b"\x22" * 48],
+                               b"m" * 32, b"\x11" * 96)
+    assert fleet.assignment(range(32)) == first
+    assert fleet.affinity_moves == 0
+    assert fleet.committees_routed == 32
+    assert len(set(first.values())) > 1  # committees actually spread
+
+    # ring churn moves only the drained worker's committees
+    fake._ring.remove("w1")
+    moved = sum(1 for ci, lb in first.items()
+                if fleet.label_for(ci) != lb)
+    assert moved == sum(1 for lb in first.values() if lb == "w1")
+    for ci in range(32):
+        fleet.submit_committee(ci, "fast_aggregate", [b"\x22" * 48],
+                               b"m" * 32, b"\x11" * 96)
+    assert fleet.affinity_moves == moved
+
+
+# ---------------------------------------------------------------------------
+# hierarchy: slot fold accounting + bisection localization
+# ---------------------------------------------------------------------------
+
+
+def test_verify_slot_accounting_and_bad_committee_localization():
+    reg = Registry(64, seed=13, slots_per_epoch=8, target_size=2,
+                   shuffle_rounds=4)
+    assert reg.committees_per_slot() == 4
+    items = hierarchy.committee_items(reg, slot=3)
+    bad_ci = 2
+    items[bad_ci] = hierarchy.corrupt_item(items[bad_ci])
+
+    plane = pubkeys.PubkeyPlane(budget_bytes=1 << 30, mirror_backend=True)
+    report = hierarchy.verify_slot(items, slot=3, plane=plane)
+    assert report.committees == 4
+    assert report.attestations == sum(len(it[1]) for it in items)
+    assert report.bad_committees == [bad_ci]
+    assert report.bisections >= 1  # the slot root failed and split
+    assert report.pubkey_misses > 0 and report.pubkey_hits == 0
+
+    flat = hierarchy.verify_slot_flat(items)
+    oracle = hierarchy.verify_slot_oracle(items)
+    assert report.verdicts.tolist() == flat.tolist() == oracle.tolist()
+
+    # all-valid slot: ONE combine, ONE final exp, no bisection; the
+    # pubkey plane serves the whole slot from residency
+    good = hierarchy.committee_items(reg, slot=3)
+    report2 = hierarchy.verify_slot(good, slot=3, plane=plane)
+    assert report2.all_valid and not report2.bad_committees
+    assert report2.combines == 1 and report2.bisections == 0
+    assert report2.final_exps_per_slot == 1.0
+    assert report2.pubkey_hits > 0 and report2.pubkey_misses == 0

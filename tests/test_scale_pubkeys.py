@@ -1,0 +1,76 @@
+"""Tier-1 coverage for the mainnet-scale pubkey plane (ISSUE 20):
+bytes-exact LRU accounting and eviction, mirroring into (and eviction
+out of) the backend `_PK_CACHE`, and batched-decompression equivalence
+against the per-key decode path. Crypto is kept to a handful of tiny
+keys so the module stays inside the tier-1 budget; the registry /
+routing / hierarchy halves of the plane live in test_scale.py."""
+import numpy as np
+import pytest
+
+from consensus_specs_tpu.scale import pubkeys
+
+
+def _real_pubkeys(n, base=1):
+    from consensus_specs_tpu.utils import bls
+
+    return [bls.SkToPk((base + i) << 4) for i in range(n)]
+
+
+def test_pubkey_plane_byte_accounting_and_eviction():
+    pks = _real_pubkeys(6)
+    probe = pubkeys.PubkeyPlane(budget_bytes=1 << 30, mirror_backend=False)
+    probe.warm(pks[:1])
+    per_entry = probe.bytes
+    assert per_entry > 48  # decompressed limbs dominate
+
+    plane = pubkeys.PubkeyPlane(budget_bytes=3 * per_entry,
+                                mirror_backend=False)
+    hits, misses = plane.warm(pks[:3])
+    assert (hits, misses) == (0, 3)
+    assert plane.bytes == 3 * per_entry <= plane.budget_bytes
+    assert len(plane) == 3 and plane.evictions == 0
+
+    hits, misses = plane.warm(pks[:3])
+    assert (hits, misses) == (3, 0)
+
+    # two more keys force two LRU evictions; accounting stays exact
+    plane.warm(pks[3:5])
+    assert plane.evictions == 2
+    assert plane.bytes == 3 * per_entry
+    assert pks[0] not in plane and pks[1] not in plane
+    assert pks[4] in plane
+    assert plane.hit_rate() == pytest.approx(3 / 8)
+
+
+def test_pubkey_plane_mirrors_and_unmirrors_backend_cache():
+    from consensus_specs_tpu.ops import bls_backend
+
+    pks = _real_pubkeys(3, base=100)
+    for pk in pks:
+        bls_backend._PK_CACHE.pop(pk, None)
+    probe = pubkeys.PubkeyPlane(budget_bytes=1 << 30, mirror_backend=False)
+    probe.warm(pks[:1])
+    plane = pubkeys.PubkeyPlane(budget_bytes=2 * probe.bytes)
+    plane.warm(pks)
+    assert plane.evictions == 1
+    # resident keys are warm in the backend cache; evicted keys are not
+    assert pks[0] not in bls_backend._PK_CACHE
+    assert pks[1] in bls_backend._PK_CACHE and pks[2] in bls_backend._PK_CACHE
+    for pk in pks:
+        bls_backend._PK_CACHE.pop(pk, None)
+
+
+def test_pubkey_plane_batched_equals_per_key_decode():
+    from consensus_specs_tpu.ops import bls_backend
+
+    pks = _real_pubkeys(4, base=50)
+    bad = b"\xa0" + b"\xff" * 47  # x out of range: rejected, never cached
+    inf = b"\xc0" + b"\x00" * 47  # infinity: invalid as a pubkey
+    plane = pubkeys.PubkeyPlane(budget_bytes=1 << 30, mirror_backend=False)
+    plane.warm(pks + [bad, inf])
+    assert plane.rejected == 2 and len(plane) == 4
+    for pk in pks:
+        got_x, got_y = plane.get(pk)
+        want_x, want_y = bls_backend._pubkey_limbs_compute(pk)
+        np.testing.assert_array_equal(np.asarray(got_x), np.asarray(want_x))
+        np.testing.assert_array_equal(np.asarray(got_y), np.asarray(want_y))

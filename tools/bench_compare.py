@@ -93,6 +93,17 @@ wrong state roots is a consensus-correctness regression, not a perf
 number); the cold/incremental/proof-world speedups and roots/sec are
 report-only.
 
+Mainnet gating: rounds that carry a ``mainnet`` section (`bench.py
+--mode mainnet` — the mainnet-scale slot replay over the synthetic
+million-validator registry) gate on the same state rule: a section
+whose correctness claim held in the previous round (hierarchical
+verdicts identical to the flat path under the memory budget, a planted
+bad committee localized exactly by bisection, censored_aggregates
+converging through the strict sim gate, committee affinity with zero
+moves) and breaks in the newest fails the round outright ("MAINNET
+DIVERGED" — verdict identity at scale is a consensus-correctness claim,
+not a perf number); attestations/sec and RSS movement are report-only.
+
 Health gating: rounds that carry a ``health`` section (`bench.py --mode
 soak` — the long-horizon consensus health ledger) gate on the same
 state rule: a soak whose gate (participation floor, bounded finality
@@ -369,6 +380,36 @@ def extract_merkle(doc):
     return out
 
 
+def extract_mainnet(doc):
+    """{``platform:mainnet:<section>``: {"ok", "atts_per_sec"}} from one
+    round's ``mainnet`` section (`bench.py --mode mainnet` mainnet-scale
+    slot-replay sections; ``ok`` = the section's correctness claim held —
+    hierarchical verdicts matching the flat/oracle path, bisection
+    localizing the planted bad committee, the censored sim converging
+    through the strict gate, committee affinity staying put).
+    Attestations/sec and every other throughput figure are report-only."""
+    parsed = doc.get("parsed")
+    if not isinstance(parsed, dict) or "error" in parsed:
+        return {}
+    section = parsed.get("mainnet")
+    if not isinstance(section, dict):
+        return {}
+    plat = _platform(parsed)
+    out = {}
+    for name, row in sorted(section.items()):
+        if not isinstance(row, dict) or "ok" not in row:
+            continue
+        try:
+            aps = float(row.get("atts_per_sec") or 0.0)
+        except (TypeError, ValueError):
+            aps = 0.0
+        out[f"{plat}:mainnet:{name}"] = {
+            "ok": bool(row.get("ok", False)),
+            "atts_per_sec": aps,
+        }
+    return out
+
+
 def extract_vmexec(doc):
     """{``platform:vmexec:<kind,rows>``: {"ok", "fused_ms_row",
     "interp_ms_row"}} from one round's ``vmexec`` section (`bench.py
@@ -571,6 +612,7 @@ def main(argv=None) -> int:
         new_proofs = extract_proofs(newest_doc)
         new_merkle = extract_merkle(newest_doc)
         new_health = extract_health(newest_doc)
+        new_mainnet = extract_mainnet(newest_doc)
     except (OSError, ValueError) as e:
         print(f"bench-compare: FAIL — {os.path.basename(newest)} unreadable: {e}")
         return 1
@@ -587,6 +629,7 @@ def main(argv=None) -> int:
     prev_vals, prev_slo, prev_sim, prev_mesh = {}, {}, {}, {}
     prev_fx, prev_vx, prev_fleet, prev_lat = {}, {}, {}, {}
     prev_proofs, prev_merkle, prev_health, prev_path = {}, {}, {}, None
+    prev_mainnet = {}
     for path in reversed(files[:-1]):
         try:
             doc = _load(path)
@@ -601,22 +644,23 @@ def main(argv=None) -> int:
             prev_proofs = extract_proofs(doc)
             prev_merkle = extract_merkle(doc)
             prev_health = extract_health(doc)
+            prev_mainnet = extract_mainnet(doc)
         except (OSError, ValueError):
             prev_vals, prev_slo, prev_sim = {}, {}, {}
             prev_mesh, prev_fx, prev_vx = {}, {}, {}
             prev_fleet, prev_lat, prev_proofs = {}, {}, {}
-            prev_merkle, prev_health = {}, {}
+            prev_merkle, prev_health, prev_mainnet = {}, {}, {}
         # an SLO-only or sim-only round (headline errored, objectives or
         # scenario matrix still recorded) is a usable baseline for its
         # state gate even with no throughput number
         if (prev_vals or prev_slo or prev_sim or prev_mesh or prev_fx
                 or prev_vx or prev_fleet or prev_lat or prev_proofs
-                or prev_merkle or prev_health):
+                or prev_merkle or prev_health or prev_mainnet):
             prev_path = path
             break
     if not (prev_vals or prev_slo or prev_sim or prev_mesh or prev_fx
             or prev_vx or prev_fleet or prev_lat or prev_proofs
-            or prev_merkle or prev_health):
+            or prev_merkle or prev_health or prev_mainnet):
         print("bench-compare: SKIP — no earlier round recorded a usable value")
         return 0
 
@@ -631,10 +675,12 @@ def main(argv=None) -> int:
     proofs_common = sorted(set(new_proofs) & set(prev_proofs))
     merkle_common = sorted(set(new_merkle) & set(prev_merkle))
     health_common = sorted(set(new_health) & set(prev_health))
+    mainnet_common = sorted(set(new_mainnet) & set(prev_mainnet))
     if (not common and not slo_common and not sim_common
             and not mesh_common and not fx_common and not vx_common
             and not fleet_common and not lat_common and not proofs_common
-            and not merkle_common and not health_common):
+            and not merkle_common and not health_common
+            and not mainnet_common):
         # SLO keys count as comparables too: two rounds that share no
         # throughput shape but both declare serve_p99 must still gate the
         # objective state, not skip past it
@@ -905,6 +951,35 @@ def main(argv=None) -> int:
         if broke:
             failures.append(key)
 
+    # mainnet state gate (ISSUE 20): a mainnet-scale replay section whose
+    # correctness claim held last round and breaks now fails outright —
+    # "MAINNET DIVERGED". Each section's ok is a verdict-identity claim
+    # (hierarchical fold matching the flat/oracle path under budget, the
+    # planted bad committee localized exactly, censored_aggregates
+    # converging through the strict sim gate, committee affinity with
+    # zero moves) — losing any of them at million-validator shape is a
+    # consensus-correctness regression; attestations/sec movement is
+    # report-only like every other CPU throughput figure
+    for key in mainnet_common:
+        old, new = prev_mainnet[key], new_mainnet[key]
+        diverged = old["ok"] and not new["ok"]
+        status = "MAINNET DIVERGED" if diverged else (
+            "ok" if new["ok"] else "still diverged")
+        print(
+            f"  {key}: {old['atts_per_sec']:.1f} -> "
+            f"{new['atts_per_sec']:.1f} atts/sec "
+            f"(ok: {old['ok']} -> {new['ok']})"
+            f"{'  ' + status if diverged else ''}"
+        )
+        rows.append((key, f"{old['atts_per_sec']:.1f}",
+                     f"{new['atts_per_sec']:.1f}",
+                     (new["atts_per_sec"] - old["atts_per_sec"])
+                     / old["atts_per_sec"]
+                     if old["atts_per_sec"] else None,
+                     status))
+        if diverged:
+            failures.append(key)
+
     _emit_markdown(rows, os.path.basename(prev_path),
                    os.path.basename(newest), args.max_regression,
                    trajectory=headline_trajectory(files))
@@ -936,6 +1011,8 @@ def main(argv=None) -> int:
            if merkle_common else "")
         + (f", {len(health_common)} health scope(s) gated"
            if health_common else "")
+        + (f", {len(mainnet_common)} mainnet section(s) gated"
+           if mainnet_common else "")
     )
     return 0
 
